@@ -1,0 +1,13 @@
+"""coconut_tpu — TPU-native framework for Coconut threshold-issuance
+selective-disclosure anonymous credentials over BLS12-381.
+
+Capability surface mirrors the reference (3for/coconut-rust, see SURVEY.md):
+setup, threshold keygen (Shamir / Pedersen-VSS / dealerless Pedersen-DVSS),
+blind signature requests with Schnorr PoKs, blind signing / unblinding,
+Lagrange aggregation of signatures and verkeys, PS verification, and
+selective-disclosure proof of knowledge of a credential. The data-parallel
+hot paths (batched MSM + pairing-product checks) route through a
+`CurveBackend` seam onto JAX/TPU.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,44 @@
+"""Typed errors mirroring the reference's `CoconutErrorKind` (errors.rs:5-24),
+with the SURVEY.md §5 mandate applied: no asserts in library code — hot-path
+`assert!`/`unwrap` in the reference (signature.rs:133-134,289-290,449,477)
+become raised, typed exceptions here."""
+
+
+class CoconutError(Exception):
+    """Base class for all framework errors (reference: errors.rs:26-56)."""
+
+
+class UnsupportedNoOfMessages(CoconutError):
+    """Verkey valid for `expected` messages but given `given` (errors.rs:7-11)."""
+
+    def __init__(self, expected, given):
+        super().__init__(
+            "Verkey valid for %d messages but given %d messages" % (expected, given)
+        )
+        self.expected = expected
+        self.given = given
+
+
+class UnequalNoOfBasesExponents(CoconutError):
+    """Same number of bases and exponents required (errors.rs:13-17)."""
+
+    def __init__(self, bases, exponents):
+        super().__init__(
+            "Same no of bases and exponents required. %d bases and %d exponents"
+            % (bases, exponents)
+        )
+        self.bases = bases
+        self.exponents = exponents
+
+
+class PSError(CoconutError):
+    """Error raised by the PS-signature layer (errors.rs:19-20; ps_sig::errors)."""
+
+
+class DeserializationError(CoconutError):
+    """Malformed or non-canonical byte encoding (rebuild addition: the
+    reference had no wire validation — SURVEY.md §4 'gaps to improve')."""
+
+
+class GeneralError(CoconutError):
+    """Catch-all with a message (errors.rs:22-23)."""

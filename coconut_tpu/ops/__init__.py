@@ -1,0 +1,5 @@
+"""Low-level cryptographic operations: fields, curves, pairing, hashing,
+serialization. The pure-Python modules here are the bit-exact specification
+implemented natively by `core/` (C++) and in batch by `coconut_tpu/tpu/`."""
+
+from . import curve, fields, hashing, pairing, serialize  # noqa: F401

@@ -1,0 +1,105 @@
+"""Hashing to fields and groups — the framework's canonical spec ("CTH-v1").
+
+Replaces `amcl_wrapper`'s `from_msg_hash` surface (reference call sites:
+Params setup signature.rs:23-29, anti-malleability generator `h`
+signature.rs:205, Fiat-Shamir challenges signature.rs:598 / pok_sig.rs:94).
+The reference inherits amcl's (unspecified, offline-unavailable) map; we
+define our own deterministic spec, shared bit-exactly by the Python, C++ and
+TPU backends:
+
+  - expand_message_xmd with SHA-256 (RFC 9380 §5.3.1 construction).
+  - hash_to_fr / hash_to_fp: 64 uniform bytes reduced mod r / mod p.
+  - hash_to_g1 / hash_to_g2: try-and-increment — for ctr = 0,1,2,...:
+    x = hash_to_field(msg, dst || I2OSP(ctr,1)); if x^3 + b is square, take
+    y with sgn0(y) == 0, then clear the cofactor. Not constant-time, which is
+    acceptable: every use site hashes *public* data (labels, commitments,
+    known messages, proof transcripts).
+"""
+
+import hashlib
+
+from .curve import G1_COFACTOR, G2_COFACTOR, g1, g2
+from .fields import P, R, fp2_sgn0, fp2_sqrt, fp_sgn0, fp_sqrt
+
+_HASH = hashlib.sha256
+_B_IN_BYTES = 32
+_R_IN_BYTES = 64
+
+
+def expand_message_xmd(msg, dst, len_in_bytes):
+    """RFC 9380 §5.3.1 expand_message_xmd with SHA-256."""
+    if len(dst) > 255:
+        raise ValueError("DST longer than 255 bytes")
+    ell = (len_in_bytes + _B_IN_BYTES - 1) // _B_IN_BYTES
+    if ell > 255:
+        raise ValueError("requested too many bytes")
+    dst_prime = dst + bytes([len(dst)])
+    z_pad = b"\x00" * _R_IN_BYTES
+    l_i_b_str = len_in_bytes.to_bytes(2, "big")
+    b0 = _HASH(z_pad + msg + l_i_b_str + b"\x00" + dst_prime).digest()
+    b1 = _HASH(b0 + b"\x01" + dst_prime).digest()
+    blocks = [b1]
+    for i in range(2, ell + 1):
+        prev = blocks[-1]
+        xored = bytes(x ^ y for x, y in zip(b0, prev))
+        blocks.append(_HASH(xored + bytes([i]) + dst_prime).digest())
+    return b"".join(blocks)[:len_in_bytes]
+
+
+DST_FR = b"COCONUT-TPU-V1-FR"
+DST_G1 = b"COCONUT-TPU-V1-G1"
+DST_G2 = b"COCONUT-TPU-V1-G2"
+
+
+def hash_to_fr(msg, dst=DST_FR):
+    """Hash arbitrary bytes to a scalar in Fr (Fiat-Shamir challenges;
+    reference analogue: FieldElement::from_msg_hash, signature.rs:598)."""
+    u = expand_message_xmd(msg, dst, 64)
+    return int.from_bytes(u, "big") % R
+
+
+def _hash_to_fp(msg, dst):
+    u = expand_message_xmd(msg, dst, 64)
+    return int.from_bytes(u, "big") % P
+
+
+def _hash_to_fp2(msg, dst):
+    u = expand_message_xmd(msg, dst, 128)
+    return (
+        int.from_bytes(u[:64], "big") % P,
+        int.from_bytes(u[64:], "big") % P,
+    )
+
+
+def hash_to_g1(msg, dst=DST_G1):
+    """Deterministic hash to G1 (try-and-increment + cofactor clearing)."""
+    for ctr in range(256):
+        x = _hash_to_fp(msg, dst + bytes([ctr]))
+        y2 = (x * x % P * x + 4) % P
+        y = fp_sqrt(y2)
+        if y is None:
+            continue
+        if fp_sgn0(y) == 1:
+            y = P - y
+        pt = g1.mul((x, y), G1_COFACTOR)
+        if pt is not None:
+            return pt
+    raise ValueError("hash_to_g1 failed (probability ~2^-256)")
+
+
+def hash_to_g2(msg, dst=DST_G2):
+    """Deterministic hash to G2 (try-and-increment + cofactor clearing)."""
+    for ctr in range(256):
+        x = _hash_to_fp2(msg, dst + bytes([ctr]))
+        from .fields import fp2_add, fp2_mul, fp2_sq
+
+        y2 = fp2_add(fp2_mul(fp2_sq(x), x), (4, 4))
+        y = fp2_sqrt(y2)
+        if y is None:
+            continue
+        if fp2_sgn0(y) == 1:
+            y = ((P - y[0]) % P, (P - y[1]) % P)
+        pt = g2.mul((x, y), G2_COFACTOR)
+        if pt is not None:
+            return pt
+    raise ValueError("hash_to_g2 failed (probability ~2^-256)")

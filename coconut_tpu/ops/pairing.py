@@ -1,0 +1,181 @@
+"""Optimal-ate pairing on BLS12-381 — pure-Python reference implementation.
+
+Replaces the pairing layer the reference reaches through `ps_sig`
+(`ate_2_pairing` re-export, reference lib.rs:13; used inside
+`PSSignature::verify` and `PoKOfSignature`, reached via signature.rs:477 and
+pok_sig.rs:85-105).
+
+Spec decisions (all backends must match these *final* GT values; intermediate
+Miller values may differ by subfield factors, which the final exponentiation
+kills):
+  - e(P, Q) := final_exp(miller_loop(P, Q))
+  - final_exp(f) := f ** (3 * (p^12 - 1) / r)   — note the 3x multiple, which
+    makes the hard part expressible as an exact polynomial in the BLS
+    parameter x (Hayashida-Hayasaka-Teruya): (x-1)^2 (x+p) (x^2+p^2-1) + 3.
+    Cubing is a bijection on the order-r target group, so the pairing check
+    `== 1` and bilinearity are unaffected.
+  - pairing products share one final exponentiation:
+    multi_pairing([(P_i, Q_i)]) = final_exp(prod_i miller_loop(P_i, Q_i)),
+    which is also exactly the TPU batch-verify structure.
+
+The Miller loop here runs on the curve over Fp12 via the untwist
+(x', y') -> (x'/w^2, y'/w^3), w^6 = xi — simple and auditable; the C++ and
+TPU backends use twist-coordinate line evaluation for speed.
+"""
+
+from .fields import (
+    BLS_X,
+    FP2_ZERO,
+    FP6_ZERO,
+    FP6_ONE,
+    FP12_ONE,
+    P,
+    R,
+    fp12_conj,
+    fp12_frobenius,
+    fp12_frobenius2,
+    fp12_inv,
+    fp12_mul,
+    fp12_pow,
+    fp12_sq,
+    fp12_sub,
+)
+
+# --- Fp12 embedding helpers ------------------------------------------------
+
+
+def _embed_fp(a):
+    """Fp -> Fp12."""
+    return (((a % P, 0), FP2_ZERO, FP2_ZERO), FP6_ZERO)
+
+
+def _embed_fp2(c):
+    """Fp2 -> Fp12."""
+    return ((c, FP2_ZERO, FP2_ZERO), FP6_ZERO)
+
+
+# w, w^2 = v, w^3 = v*w as Fp12 elements; inverses precomputed once.
+_W2 = ((FP2_ZERO, (1, 0), FP2_ZERO), FP6_ZERO)  # v
+_W3 = (FP6_ZERO, (FP2_ZERO, (1, 0), FP2_ZERO))  # v*w
+_W2_INV = fp12_inv(_W2)
+_W3_INV = fp12_inv(_W3)
+
+
+def untwist(q):
+    """Map a G2 point on the twist E'(Fp2) to E(Fp12): (x,y) -> (x/w^2, y/w^3)."""
+    x, y = q
+    return (fp12_mul(_embed_fp2(x), _W2_INV), fp12_mul(_embed_fp2(y), _W3_INV))
+
+
+# --- Miller loop -----------------------------------------------------------
+
+_X_ABS_BITS = bin(-BLS_X)[2:]
+
+
+def miller_loop(p1, q2):
+    """Miller loop f_{|x|,Q}(P) with the end conjugation for x < 0.
+
+    p1: G1 affine (Fp pair) or None; q2: G2 affine (Fp2 pair) or None.
+    Returns an Fp12 element (1 if either input is the identity).
+    """
+    if p1 is None or q2 is None:
+        return FP12_ONE
+    px = _embed_fp(p1[0])
+    py = _embed_fp(p1[1])
+    qx, qy = untwist(q2)
+    tx, ty = qx, qy
+    f = FP12_ONE
+    for bit in _X_ABS_BITS[1:]:
+        # tangent line at T evaluated at P
+        lam = fp12_mul(
+            fp12_mul(fp12_sq(tx), _embed_fp(3)),
+            fp12_inv(fp12_mul(ty, _embed_fp(2))),
+        )
+        line = fp12_sub(fp12_sub(py, ty), fp12_mul(lam, fp12_sub(px, tx)))
+        f = fp12_mul(fp12_sq(f), line)
+        # T <- 2T
+        x3 = fp12_sub(fp12_sq(lam), fp12_mul(tx, _embed_fp(2)))
+        ty = fp12_sub(fp12_mul(lam, fp12_sub(tx, x3)), ty)
+        tx = x3
+        if bit == "1":
+            # chord line through T and Q evaluated at P
+            if tx == qx:
+                raise ValueError("degenerate Miller addition step (T == +-Q)")
+            lam = fp12_mul(fp12_sub(ty, qy), fp12_inv(fp12_sub(tx, qx)))
+            line = fp12_sub(fp12_sub(py, qy), fp12_mul(lam, fp12_sub(px, qx)))
+            f = fp12_mul(f, line)
+            x3 = fp12_sub(fp12_sub(fp12_sq(lam), tx), qx)
+            ty = fp12_sub(fp12_mul(lam, fp12_sub(tx, x3)), ty)
+            tx = x3
+    # x < 0: conjugate (inverse up to factors killed by the final exponentiation)
+    return fp12_conj(f)
+
+
+# --- Final exponentiation --------------------------------------------------
+
+# Hard-part lambda decomposition (verified exact at import):
+#   3*(p^4 - p^2 + 1)/r = lam0 + lam1*p + lam2*p^2 + lam3*p^3
+_LAM3 = (BLS_X - 1) ** 2
+_LAM2 = _LAM3 * BLS_X
+_LAM1 = _LAM3 * (BLS_X * BLS_X - 1)
+_LAM0 = _LAM2 * (BLS_X * BLS_X - 1) + 3
+assert _LAM0 + _LAM1 * P + _LAM2 * P**2 + _LAM3 * P**3 == 3 * (
+    (P**4 - P**2 + 1) // R
+)
+
+
+def _cyc_pow(a, e):
+    """a^e for `a` in the cyclotomic subgroup (so a^-1 == conj(a))."""
+    if e < 0:
+        return fp12_conj(_cyc_pow(a, -e))
+    result = FP12_ONE
+    base = a
+    while e > 0:
+        if e & 1:
+            result = fp12_mul(result, base)
+        base = fp12_sq(base)
+        e >>= 1
+    return result
+
+
+def final_exp(f):
+    """f ** (3 * (p^12 - 1) / r)."""
+    # easy part: f^((p^6 - 1)(p^2 + 1))
+    m = fp12_mul(fp12_conj(f), fp12_inv(f))
+    m = fp12_mul(fp12_frobenius2(m), m)
+    # hard part via Frobenius multi-exp; m is now cyclotomic
+    r0 = _cyc_pow(m, _LAM0)
+    r1 = fp12_frobenius(_cyc_pow(m, _LAM1))
+    r2 = fp12_frobenius2(_cyc_pow(m, _LAM2))
+    r3 = fp12_frobenius(fp12_frobenius2(_cyc_pow(m, _LAM3)))
+    return fp12_mul(fp12_mul(r0, r1), fp12_mul(r2, r3))
+
+
+def final_exp_slow(f):
+    """Direct exponentiation — cross-check oracle for final_exp (tests only)."""
+    return fp12_pow(f, 3 * ((P**12 - 1) // R))
+
+
+# --- Pairing API -----------------------------------------------------------
+
+
+def pairing(p1, q2):
+    """e(P, Q) for P in G1, Q in G2."""
+    return final_exp(miller_loop(p1, q2))
+
+
+def multi_pairing(pairs):
+    """prod_i e(P_i, Q_i) with a single shared final exponentiation.
+
+    This is the reference's `ate_2_pairing` generalized to any number of
+    pairs (lib.rs:13) and the exact shape of the TPU batched verify.
+    """
+    f = FP12_ONE
+    for p1, q2 in pairs:
+        f = fp12_mul(f, miller_loop(p1, q2))
+    return final_exp(f)
+
+
+def pairing_check(pairs):
+    """True iff prod_i e(P_i, Q_i) == 1."""
+    return multi_pairing(pairs) == FP12_ONE

@@ -1,0 +1,167 @@
+"""Canonical byte encodings for all wire/persistent types.
+
+The reference relies on amcl's `to_bytes` for Fiat-Shamir transcripts
+(signature.rs:201,271-280) and serde for persistence (signature.rs:12,39-122).
+We define one canonical spec ("CTS-v1") shared by every backend — it feeds
+both the Fiat-Shamir hashing and the checkpoint/credential store:
+
+  - Fr: 32 bytes big-endian.
+  - Fp: 48 bytes big-endian.
+  - Fp2 (c0 + c1*u): c0 || c1 (96 bytes).
+  - G1 point: 96 bytes uncompressed x || y; identity = 96 zero bytes.
+  - G2 point: 192 bytes uncompressed x || y; identity = 192 zero bytes.
+  - Compressed points (wire/storage): 48 / 96 bytes, ZCash-style flag bits in
+    the top three bits of the first byte (compr | infinity | y-sign).
+
+Deserializers validate: field elements canonical (< modulus), points on
+curve and in the r-torsion subgroup.
+"""
+
+from .curve import g1, g2
+from .fields import P, R, fp2_sgn0, fp2_sqrt, fp_sgn0, fp_sqrt
+from ..errors import DeserializationError
+
+
+def fr_to_bytes(a):
+    return int(a % R).to_bytes(32, "big")
+
+
+def fr_from_bytes(b):
+    if len(b) != 32:
+        raise DeserializationError("Fr must be 32 bytes, got %d" % len(b))
+    v = int.from_bytes(b, "big")
+    if v >= R:
+        raise DeserializationError("non-canonical Fr encoding")
+    return v
+
+
+def fp_to_bytes(a):
+    return int(a % P).to_bytes(48, "big")
+
+
+def fp_from_bytes(b):
+    if len(b) != 48:
+        raise DeserializationError("Fp must be 48 bytes, got %d" % len(b))
+    v = int.from_bytes(b, "big")
+    if v >= P:
+        raise DeserializationError("non-canonical Fp encoding")
+    return v
+
+
+def fp2_to_bytes(c):
+    return fp_to_bytes(c[0]) + fp_to_bytes(c[1])
+
+
+def fp2_from_bytes(b):
+    if len(b) != 96:
+        raise DeserializationError("Fp2 must be 96 bytes, got %d" % len(b))
+    return (fp_from_bytes(b[:48]), fp_from_bytes(b[48:]))
+
+
+# --- G1 ---------------------------------------------------------------------
+
+
+def g1_to_bytes(p):
+    """Uncompressed encoding; used for Fiat-Shamir transcripts."""
+    if p is None:
+        return b"\x00" * 96
+    return fp_to_bytes(p[0]) + fp_to_bytes(p[1])
+
+
+def g1_from_bytes(b):
+    if len(b) != 96:
+        raise DeserializationError("G1 must be 96 bytes, got %d" % len(b))
+    if b == b"\x00" * 96:
+        return None
+    p = (fp_from_bytes(b[:48]), fp_from_bytes(b[48:]))
+    if not g1.in_subgroup(p):
+        raise DeserializationError("G1 point not in the r-torsion subgroup")
+    return p
+
+
+def g1_to_compressed(p):
+    if p is None:
+        return bytes([0xC0]) + b"\x00" * 47
+    flags = 0x80 | (0x20 if fp_sgn0(p[1]) else 0)
+    raw = bytearray(fp_to_bytes(p[0]))
+    raw[0] |= flags
+    return bytes(raw)
+
+
+def g1_from_compressed(b):
+    if len(b) != 48:
+        raise DeserializationError("compressed G1 must be 48 bytes")
+    flags = b[0] & 0xE0
+    if not flags & 0x80:
+        raise DeserializationError("compression flag not set")
+    if flags & 0x40:
+        if b != bytes([0xC0]) + b"\x00" * 47:
+            raise DeserializationError("malformed G1 identity encoding")
+        return None
+    raw = bytearray(b)
+    raw[0] &= 0x1F
+    x = fp_from_bytes(bytes(raw))
+    y = fp_sqrt((x * x % P * x + 4) % P)
+    if y is None:
+        raise DeserializationError("x not on curve")
+    if fp_sgn0(y) != (1 if flags & 0x20 else 0):
+        y = P - y
+    p = (x, y)
+    if not g1.in_subgroup(p):
+        raise DeserializationError("G1 point not in the r-torsion subgroup")
+    return p
+
+
+# --- G2 ---------------------------------------------------------------------
+
+
+def g2_to_bytes(p):
+    if p is None:
+        return b"\x00" * 192
+    return fp2_to_bytes(p[0]) + fp2_to_bytes(p[1])
+
+
+def g2_from_bytes(b):
+    if len(b) != 192:
+        raise DeserializationError("G2 must be 192 bytes, got %d" % len(b))
+    if b == b"\x00" * 192:
+        return None
+    p = (fp2_from_bytes(b[:96]), fp2_from_bytes(b[96:]))
+    if not g2.in_subgroup(p):
+        raise DeserializationError("G2 point not in the r-torsion subgroup")
+    return p
+
+
+def g2_to_compressed(p):
+    if p is None:
+        return bytes([0xC0]) + b"\x00" * 95
+    flags = 0x80 | (0x20 if fp2_sgn0(p[1]) else 0)
+    raw = bytearray(fp2_to_bytes(p[0]))
+    raw[0] |= flags
+    return bytes(raw)
+
+
+def g2_from_compressed(b):
+    if len(b) != 96:
+        raise DeserializationError("compressed G2 must be 96 bytes")
+    flags = b[0] & 0xE0
+    if not flags & 0x80:
+        raise DeserializationError("compression flag not set")
+    if flags & 0x40:
+        if b != bytes([0xC0]) + b"\x00" * 95:
+            raise DeserializationError("malformed G2 identity encoding")
+        return None
+    raw = bytearray(b)
+    raw[0] &= 0x1F
+    x = fp2_from_bytes(bytes(raw))
+    from .fields import fp2_add, fp2_mul, fp2_sq
+
+    y = fp2_sqrt(fp2_add(fp2_mul(fp2_sq(x), x), (4, 4)))
+    if y is None:
+        raise DeserializationError("x not on curve")
+    if fp2_sgn0(y) != (1 if flags & 0x20 else 0):
+        y = ((P - y[0]) % P, (P - y[1]) % P)
+    p = (x, y)
+    if not g2.in_subgroup(p):
+        raise DeserializationError("G2 point not in the r-torsion subgroup")
+    return p

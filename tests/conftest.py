@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the driver separately dry-runs the
+multi-chip path): env vars must be set before any `import jax` anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,252 @@
+"""Tests for the low-level ops layer (fields, curves, pairing, hashing,
+serialization). Oracles are algebraic identities on random inputs, following
+the reference's test style (SURVEY.md §4: no golden files, no mocks), plus
+the negative/serialization coverage the reference lacked."""
+
+import random
+
+import pytest
+
+from coconut_tpu.errors import DeserializationError
+from coconut_tpu.ops import pairing as pr
+from coconut_tpu.ops import serialize as ser
+from coconut_tpu.ops.curve import G1_GEN, G2_GEN, g1, g2
+from coconut_tpu.ops.fields import (
+    BLS_X,
+    FP2_ONE,
+    FP12_ONE,
+    P,
+    R,
+    fp2_inv,
+    fp2_mul,
+    fp2_pow,
+    fp2_sq,
+    fp2_sqrt,
+    fp12_frobenius,
+    fp12_frobenius2,
+    fp12_inv,
+    fp12_mul,
+    fp12_pow,
+    fp_inv,
+    fp_sqrt,
+)
+from coconut_tpu.ops.hashing import (
+    expand_message_xmd,
+    hash_to_fr,
+    hash_to_g1,
+    hash_to_g2,
+)
+
+rng = random.Random(0xC0C0)
+
+
+def rand_fp():
+    return rng.randrange(P)
+
+
+def rand_fp2():
+    return (rand_fp(), rand_fp())
+
+
+def rand_fp12():
+    return tuple(
+        tuple(tuple(rand_fp2() for _ in range(1))[0] for _ in range(3))
+        for _ in range(2)
+    )
+
+
+def rand_fr():
+    return rng.randrange(1, R)
+
+
+class TestFields:
+    def test_curve_parameter_identities(self):
+        assert R == BLS_X**4 - BLS_X**2 + 1
+        assert P == (BLS_X - 1) ** 2 // 3 * R + BLS_X
+
+    def test_fp_inv(self):
+        for _ in range(10):
+            a = rng.randrange(1, P)
+            assert a * fp_inv(a) % P == 1
+        with pytest.raises(ZeroDivisionError):
+            fp_inv(0)
+
+    def test_fp_sqrt(self):
+        for _ in range(10):
+            a = rand_fp()
+            s = fp_sqrt(a * a % P)
+            assert s is not None and s * s % P == a * a % P
+        # a non-residue: -1 is a non-residue mod p (p = 3 mod 4)
+        assert fp_sqrt(P - 1) is None
+
+    def test_fp2_mul_inv(self):
+        for _ in range(10):
+            a, b = rand_fp2(), rand_fp2()
+            # commutativity + distributivity spot-check
+            assert fp2_mul(a, b) == fp2_mul(b, a)
+            assert fp2_mul(a, fp2_inv(a)) == FP2_ONE
+        # (u)^2 == -1
+        assert fp2_sq((0, 1)) == (P - 1, 0)
+
+    def test_fp2_sqrt(self):
+        for _ in range(10):
+            a = rand_fp2()
+            sq = fp2_sq(a)
+            s = fp2_sqrt(sq)
+            assert s is not None and fp2_sq(s) == sq
+
+    def test_fp2_pow_matches_repeated_mul(self):
+        a = rand_fp2()
+        acc = FP2_ONE
+        for i in range(8):
+            assert fp2_pow(a, i) == acc
+            acc = fp2_mul(acc, a)
+
+    def test_fp12_mul_inv_assoc(self):
+        a, b, c = rand_fp12(), rand_fp12(), rand_fp12()
+        assert fp12_mul(a, fp12_mul(b, c)) == fp12_mul(fp12_mul(a, b), c)
+        assert fp12_mul(a, fp12_inv(a)) == FP12_ONE
+
+    def test_frobenius_is_pth_power(self):
+        a = rand_fp12()
+        assert fp12_frobenius(a) == fp12_pow(a, P)
+        assert fp12_frobenius2(a) == fp12_pow(a, P * P)
+
+
+class TestCurve:
+    def test_generators(self):
+        assert g1.is_on_curve(G1_GEN) and g1.mul(G1_GEN, R) is None
+        assert g2.is_on_curve(G2_GEN) and g2.mul(G2_GEN, R) is None
+
+    def test_group_laws_g1(self):
+        a, b = rand_fr(), rand_fr()
+        pa, pb = g1.mul(G1_GEN, a), g1.mul(G1_GEN, b)
+        assert g1.add(pa, pb) == g1.mul(G1_GEN, (a + b) % R)
+        assert g1.add(pa, None) == pa
+        assert g1.add(pa, g1.neg(pa)) is None
+        assert g1.double(pa) == g1.add(pa, pa)
+        assert g1.is_on_curve(pa)
+
+    def test_group_laws_g2(self):
+        a, b = rand_fr(), rand_fr()
+        qa, qb = g2.mul(G2_GEN, a), g2.mul(G2_GEN, b)
+        assert g2.add(qa, qb) == g2.mul(G2_GEN, (a + b) % R)
+        assert g2.add(qa, g2.neg(qa)) is None
+        assert g2.double(qa) == g2.add(qa, qa)
+        assert g2.is_on_curve(qa)
+
+    @pytest.mark.parametrize("grp,gen", [(g1, G1_GEN), (g2, G2_GEN)])
+    def test_msm_matches_naive(self, grp, gen):
+        pts = [grp.mul(gen, rand_fr()) for _ in range(5)]
+        ks = [rand_fr() for _ in range(5)]
+        expected = None
+        for pt, k in zip(pts, ks):
+            expected = grp.add(expected, grp.mul(pt, k))
+        assert grp.msm(pts, ks) == expected
+
+    def test_msm_length_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            g1.msm([G1_GEN], [1, 2])
+
+    def test_msm_zero_scalars(self):
+        assert g1.msm([G1_GEN, g1.double(G1_GEN)], [0, 0]) is None
+
+
+class TestPairing:
+    def test_bilinearity(self):
+        a, b = rand_fr(), rand_fr()
+        e_ab = pr.pairing(g1.mul(G1_GEN, a), g2.mul(G2_GEN, b))
+        e_base = pr.pairing(G1_GEN, G2_GEN)
+        assert e_ab == fp12_pow(e_base, a * b % R)
+        # swap sides
+        assert e_ab == pr.pairing(g1.mul(G1_GEN, a * b % R), G2_GEN)
+
+    def test_non_degenerate(self):
+        assert pr.pairing(G1_GEN, G2_GEN) != FP12_ONE
+
+    def test_identity_inputs(self):
+        assert pr.pairing(None, G2_GEN) == FP12_ONE
+        assert pr.pairing(G1_GEN, None) == FP12_ONE
+
+    def test_final_exp_matches_slow(self):
+        f = rand_fp12()
+        assert pr.final_exp(f) == pr.final_exp_slow(f)
+
+    def test_pairing_check_product(self):
+        # e(P, bQ) * e(-bP, Q) == 1
+        b = rand_fr()
+        assert pr.pairing_check(
+            [(G1_GEN, g2.mul(G2_GEN, b)), (g1.neg(g1.mul(G1_GEN, b)), G2_GEN)]
+        )
+        # and a wrong statement fails
+        assert not pr.pairing_check(
+            [(G1_GEN, g2.mul(G2_GEN, b)), (g1.neg(G1_GEN), G2_GEN)]
+        )
+
+
+class TestHashing:
+    def test_expand_message_xmd_lengths(self):
+        out = expand_message_xmd(b"abc", b"DST", 99)
+        assert len(out) == 99
+        # deterministic
+        assert out == expand_message_xmd(b"abc", b"DST", 99)
+        # msg and dst separation
+        assert expand_message_xmd(b"abc", b"DST2", 99) != out
+        assert expand_message_xmd(b"abd", b"DST", 99) != out
+
+    def test_hash_to_fr_range_and_determinism(self):
+        c = hash_to_fr(b"challenge input")
+        assert 0 <= c < R
+        assert c == hash_to_fr(b"challenge input")
+        assert c != hash_to_fr(b"challenge inpuu")
+
+    def test_hash_to_g1_subgroup(self):
+        p = hash_to_g1(b"test : g")
+        assert g1.is_on_curve(p) and g1.mul(p, R) is None
+        assert hash_to_g1(b"test : g") == p
+        assert hash_to_g1(b"other") != p
+
+    def test_hash_to_g2_subgroup(self):
+        q = hash_to_g2(b"test : g_tilde")
+        assert g2.is_on_curve(q) and g2.mul(q, R) is None
+
+
+class TestSerialize:
+    def test_fr_roundtrip(self):
+        a = rand_fr()
+        assert ser.fr_from_bytes(ser.fr_to_bytes(a)) == a
+        with pytest.raises(DeserializationError):
+            ser.fr_from_bytes(R.to_bytes(32, "big"))
+
+    def test_g1_roundtrip(self):
+        p = g1.mul(G1_GEN, rand_fr())
+        assert ser.g1_from_bytes(ser.g1_to_bytes(p)) == p
+        assert ser.g1_from_bytes(ser.g1_to_bytes(None)) is None
+        assert ser.g1_from_compressed(ser.g1_to_compressed(p)) == p
+        assert ser.g1_from_compressed(ser.g1_to_compressed(None)) is None
+
+    def test_g2_roundtrip(self):
+        q = g2.mul(G2_GEN, rand_fr())
+        assert ser.g2_from_bytes(ser.g2_to_bytes(q)) == q
+        assert ser.g2_from_bytes(ser.g2_to_bytes(None)) is None
+        assert ser.g2_from_compressed(ser.g2_to_compressed(q)) == q
+        assert ser.g2_from_compressed(ser.g2_to_compressed(None)) is None
+
+    def test_g1_rejects_off_curve(self):
+        bad = ser.fp_to_bytes(5) + ser.fp_to_bytes(7)
+        with pytest.raises(DeserializationError):
+            ser.g1_from_bytes(bad)
+
+    def test_g1_rejects_non_subgroup(self):
+        # find a curve point not in the r-torsion (cofactor > 1)
+        x = 1
+        while True:
+            y2 = (x * x * x + 4) % P
+            y = fp_sqrt(y2)
+            if y is not None:
+                cand = (x, y)
+                if g1.mul(cand, R) is not None:
+                    break
+            x += 1
+        with pytest.raises(DeserializationError):
+            ser.g1_from_bytes(ser.fp_to_bytes(cand[0]) + ser.fp_to_bytes(cand[1]))
