@@ -8,6 +8,69 @@ Lagrange aggregation of signatures and verkeys, PS verification, and
 selective-disclosure proof of knowledge of a credential. The data-parallel
 hot paths (batched MSM + pairing-product checks) route through a
 `CurveBackend` seam onto JAX/TPU.
+
+The canonical 8-step flow (reference README.md:8-172):
+
+    from coconut_tpu import *
+
+    params = Params.new(msg_count=6, label=b"my-app")           # 1. Setup
+    sx, sy, signers = trusted_party_SSS_keygen(3, 5, params)    # 2. Keygen
+    elg_sk, elg_pk = elgamal_keygen(params.ctx.sig, params.g)   # 3. User keys
+    req, rand = SignatureRequest.new(msgs, 2, elg_pk, params)   # 4. Request
+    pok = SignatureRequestPoK.init(req, elg_pk, params)         #    + PoK
+    c = fiat_shamir_challenge(pok.to_bytes())
+    proof = pok.gen_proof(msgs[:2], rand, elg_sk, c)
+    # each signer: proof.verify(...) then                       # 5. BlindSign
+    bsig = BlindSignature.new(req, signer.sigkey, params)
+    sig = bsig.unblind(elg_sk, params.ctx)                      # 6. Unblind
+    aggr = Signature.aggregate(3, [(id, sig), ...])             # 7. AggCred
+    vk = Verkey.aggregate(3, [(id, vk_i), ...])                 #    AggKey
+    aggr.verify(msgs, vk, params)                               # 8. Verify
+    show(aggr, vk, params, msgs, {3, 5})                        #    Show
 """
+
+from .elgamal import elgamal_decrypt, elgamal_encrypt, elgamal_keygen  # noqa
+from .errors import (  # noqa
+    CoconutError,
+    DeserializationError,
+    GeneralError,
+    PSError,
+    UnequalNoOfBasesExponents,
+    UnsupportedNoOfMessages,
+)
+from .keygen import (  # noqa
+    Signer,
+    dvss_keygen,
+    keygen_from_shares,
+    trusted_party_PVSS_keygen,
+    trusted_party_SSS_keygen,
+)
+from .params import (  # noqa
+    DEFAULT_CTX,
+    SIGNATURES_IN_G1,
+    SIGNATURES_IN_G2,
+    GroupContext,
+    Params,
+)
+from .pok_sig import PoKOfSignature, PoKOfSignatureProof, show, show_verify  # noqa
+from .ps import batch_verify, ps_verify  # noqa
+from .signature import (  # noqa
+    BlindSignature,
+    Sigkey,
+    Signature,
+    SignatureRequest,
+    SignatureRequestPoK,
+    SignatureRequestProof,
+    Verkey,
+    fiat_shamir_challenge,
+)
+from .sss import (  # noqa
+    PedersenDVSSParticipant,
+    PedersenVSS,
+    get_shared_secret,
+    lagrange_basis_at_0,
+    reconstruct_secret,
+    share_secret_dvss,
+)
 
 __version__ = "0.1.0"

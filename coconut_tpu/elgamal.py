@@ -1,0 +1,26 @@
+"""Exponential ElGamal over an arbitrary group.
+
+Replaces the reference's three declarative macros (elgamal.rs:1-28) with
+plain functions parameterized by `CurveOps`. Encrypt returns the randomness
+`k` because the issuance PoK proves knowledge of it (signature.rs:175-178)."""
+
+from .sss import rand_fr
+
+
+def elgamal_keygen(ops, base):
+    """(sk, base^sk) — elgamal.rs:1-9."""
+    sk = rand_fr()
+    return sk, ops.mul(base, sk)
+
+
+def elgamal_encrypt(ops, base, pk, msg_point):
+    """(base^k, pk^k * msg, k) — elgamal.rs:11-20."""
+    k = rand_fr()
+    c1 = ops.mul(base, k)
+    c2 = ops.add(ops.mul(pk, k), msg_point)
+    return c1, c2, k
+
+
+def elgamal_decrypt(ops, c1, c2, sk):
+    """c2 - c1^sk — elgamal.rs:22-28."""
+    return ops.sub(c2, ops.mul(c1, sk))

@@ -1,0 +1,140 @@
+"""Threshold key generation: Shamir (trusted dealer), Pedersen VSS (trusted
+dealer, verifiable), and Pedersen DVSS (dealerless). Rebuilds keygen.rs.
+
+The t-of-n structure is the protocol's fault-tolerance mechanism: any n-t
+signers can fail and aggregation still succeeds; PVSS lets signers detect a
+malicious dealer; DVSS removes the dealer entirely (SURVEY.md §5)."""
+
+from collections import namedtuple
+
+from .errors import GeneralError
+from .ops.fields import R
+from .signature import Sigkey, Verkey
+from .sss import PedersenVSS, get_shared_secret, share_secret_dvss
+
+
+class Signer:
+    """id (1-based), signing key, verification key (keygen.rs:10-14)."""
+
+    def __init__(self, signer_id, sigkey, verkey):
+        self.id = signer_id
+        self.sigkey = sigkey
+        self.verkey = verkey
+
+
+def keygen_from_shares(num_signers, x_shares, y_shares, params):
+    """Lift secret shares to per-signer keys: alpha_i = g_tilde^{x_i},
+    beta_i[j] = g_tilde^{y_i[j]} (keygen.rs:17-45)."""
+    x_shares = dict(x_shares)
+    y_shares = [dict(m) for m in y_shares]
+    ops = params.ctx.other
+    signers = []
+    for i in range(num_signers):
+        sid = i + 1
+        try:
+            x_i = x_shares.pop(sid)
+            y_i = [m.pop(sid) for m in y_shares]
+        except KeyError:
+            raise GeneralError("missing share for signer id %d" % sid)
+        alpha_i = ops.mul(params.g_tilde, x_i)
+        beta_i = [ops.mul(params.g_tilde, y) for y in y_i]
+        signers.append(
+            Signer(sid, Sigkey(x_i, y_i), Verkey(alpha_i, beta_i))
+        )
+    return signers
+
+
+def trusted_party_SSS_keygen(threshold, total, params):
+    """"TTPKeyGen" via plain Shamir (keygen.rs:53-71). Returns
+    (secret_x, secret_y list, signers); the first two are the master secrets
+    and should be destroyed by a real dealer."""
+    secret_x, x_shares = get_shared_secret(threshold, total)
+    secret_y, y_shares = [], []
+    for _ in range(params.msg_count()):
+        s, shares = get_shared_secret(threshold, total)
+        secret_y.append(s)
+        y_shares.append(shares)
+    return secret_x, secret_y, keygen_from_shares(total, x_shares, y_shares, params)
+
+
+PVSSKeygenOutput = namedtuple(
+    "PVSSKeygenOutput",
+    [
+        "secret_x",
+        "secret_y",
+        "signers",
+        "secret_x_t",
+        "comm_coeff_x",
+        "x_shares",
+        "x_t_shares",
+        "secret_y_t",
+        "comm_coeff_y",
+        "y_shares",
+        "y_t_shares",
+    ],
+)
+
+
+def trusted_party_PVSS_keygen(threshold, total, params, g, h):
+    """Keygen via Pedersen VSS (keygen.rs:74-122): same field order as the
+    reference's 11-tuple, as a named tuple, so each signer can
+    `PedersenVSS.verify_share` its share against the coefficient commitments
+    (README.md:52-68)."""
+    secret_x, secret_x_t, comm_coeff_x, x_shares, x_t_shares = PedersenVSS.deal(
+        threshold, total, g, h
+    )
+    secret_y, secret_y_t, comm_coeff_y, y, y_t = [], [], [], [], []
+    for _ in range(params.msg_count()):
+        s, s_t, cc, shares, t_shares = PedersenVSS.deal(threshold, total, g, h)
+        secret_y.append(s)
+        secret_y_t.append(s_t)
+        comm_coeff_y.append(cc)
+        y.append(shares)
+        y_t.append(t_shares)
+    signers = keygen_from_shares(total, x_shares, y, params)
+    return PVSSKeygenOutput(
+        secret_x,
+        secret_y,
+        signers,
+        secret_x_t,
+        comm_coeff_x,
+        x_shares,
+        x_t_shares,
+        secret_y_t,
+        comm_coeff_y,
+        y,
+        y_t,
+    )
+
+
+def dvss_keygen(threshold, total, params, g, h):
+    """Dealerless keygen via Pedersen DVSS (reference: test-only driver
+    `setup_signers_for_test`, keygen.rs:167-205 — promoted to library code
+    here). Each of x, y_1..y_q is produced by a full decentralized sharing
+    round; the returned master secrets exist only because this simulates all
+    participants in-process (for tests/benches — a real deployment never
+    materializes them)."""
+    secret_x = 0
+    x_shares = {}
+    participants_x = share_secret_dvss(threshold, total, g, h)
+    for p in participants_x:
+        x_shares[p.id] = p.secret_share
+        secret_x = (secret_x + p.secret) % R
+    secret_y = []
+    y_shares = []
+    for _ in range(params.msg_count()):
+        participants_y = share_secret_dvss(threshold, total, g, h)
+        shares = {}
+        sec = 0
+        for p in participants_y:
+            shares[p.id] = p.secret_share
+            sec = (sec + p.secret) % R
+        y_shares.append(shares)
+        secret_y.append(sec)
+    signers = keygen_from_shares(total, x_shares, y_shares, params)
+    return secret_x, secret_y, signers
+
+
+# Reference-name alias (keygen.rs:169): the reference exposes the DVSS setup
+# only under this test-scoped name.
+setup_signers_for_test = dvss_keygen

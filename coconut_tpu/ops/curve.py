@@ -19,6 +19,12 @@ secret-scalar paths.
 from .fields import (
     P,
     R,
+    fp_add,
+    fp_inv,
+    fp_mul,
+    fp_neg,
+    fp_sq,
+    fp_sub,
     fp2_add,
     fp2_inv,
     fp2_mul,
@@ -214,17 +220,13 @@ class CurveOps:
         return p == q
 
 
-def _fp_sq(a):
-    return a * a % P
-
-
 g1 = CurveOps(
-    f_add=lambda a, b: (a + b) % P,
-    f_sub=lambda a, b: (a - b) % P,
-    f_mul=lambda a, b: a * b % P,
-    f_sq=_fp_sq,
-    f_neg=lambda a: (-a) % P,
-    f_inv=lambda a: pow(a, -1, P),
+    f_add=fp_add,
+    f_sub=fp_sub,
+    f_mul=fp_mul,
+    f_sq=fp_sq,
+    f_neg=fp_neg,
+    f_inv=fp_inv,
     zero=0,
     one=1,
     b=B_G1,

@@ -67,6 +67,10 @@ def fp_mul(a, b):
     return (a * b) % P
 
 
+def fp_sq(a):
+    return a * a % P
+
+
 def fp_neg(a):
     return (-a) % P
 

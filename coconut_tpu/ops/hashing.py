@@ -19,7 +19,17 @@ TPU backends:
 import hashlib
 
 from .curve import G1_COFACTOR, G2_COFACTOR, g1, g2
-from .fields import P, R, fp2_sgn0, fp2_sqrt, fp_sgn0, fp_sqrt
+from .fields import (
+    P,
+    R,
+    fp2_add,
+    fp2_mul,
+    fp2_sgn0,
+    fp2_sq,
+    fp2_sqrt,
+    fp_sgn0,
+    fp_sqrt,
+)
 
 _HASH = hashlib.sha256
 _B_IN_BYTES = 32
@@ -91,8 +101,6 @@ def hash_to_g2(msg, dst=DST_G2):
     """Deterministic hash to G2 (try-and-increment + cofactor clearing)."""
     for ctr in range(256):
         x = _hash_to_fp2(msg, dst + bytes([ctr]))
-        from .fields import fp2_add, fp2_mul, fp2_sq
-
         y2 = fp2_add(fp2_mul(fp2_sq(x), x), (4, 4))
         y = fp2_sqrt(y2)
         if y is None:

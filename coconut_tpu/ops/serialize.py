@@ -18,7 +18,17 @@ curve and in the r-torsion subgroup.
 """
 
 from .curve import g1, g2
-from .fields import P, R, fp2_sgn0, fp2_sqrt, fp_sgn0, fp_sqrt
+from .fields import (
+    P,
+    R,
+    fp2_add,
+    fp2_mul,
+    fp2_sgn0,
+    fp2_sq,
+    fp2_sqrt,
+    fp_sgn0,
+    fp_sqrt,
+)
 from ..errors import DeserializationError
 
 
@@ -154,8 +164,6 @@ def g2_from_compressed(b):
     raw = bytearray(b)
     raw[0] &= 0x1F
     x = fp2_from_bytes(bytes(raw))
-    from .fields import fp2_add, fp2_mul, fp2_sq
-
     y = fp2_sqrt(fp2_add(fp2_mul(fp2_sq(x), x), (4, 4)))
     if y is None:
         raise DeserializationError("x not on curve")

@@ -1,0 +1,121 @@
+"""System parameters and group-assignment configuration.
+
+The reference picks which pairing group holds signatures vs verkeys through
+cargo features `SignatureG1`/`SignatureG2` (Cargo.toml:24-27, lib.rs:3-4) —
+with the wiring quirk that the flags don't actually forward to ps_sig
+(SURVEY.md §1). Here the choice is a real runtime config: a `GroupContext`
+object is the single source of truth, carried inside `Params`.
+
+`Params.new` reproduces the reference's deterministic label-derived setup
+(signature.rs:22-32): all parties derive identical params from a label, which
+is the implicit config-distribution mechanism — params need no storage or
+network distribution (SURVEY.md §5 checkpoint notes).
+"""
+
+from .errors import DeserializationError, GeneralError
+from .ops import serialize as ser
+from .ops.curve import g1 as _g1_ops, g2 as _g2_ops
+from .ops.hashing import hash_to_g1, hash_to_g2
+from .ops.pairing import pairing_check as _raw_pairing_check
+
+
+class GroupContext:
+    """Binds the abstract roles SignatureGroup / OtherGroup to concrete
+    groups, with hashing, serialization, and correctly-ordered pairing."""
+
+    def __init__(self, name):
+        if name == "G1":
+            self.sig, self.other = _g1_ops, _g2_ops
+            self.hash_to_sig, self.hash_to_other = hash_to_g1, hash_to_g2
+            self.sig_to_bytes, self.other_to_bytes = (
+                ser.g1_to_bytes,
+                ser.g2_to_bytes,
+            )
+            self.sig_from_bytes, self.other_from_bytes = (
+                ser.g1_from_bytes,
+                ser.g2_from_bytes,
+            )
+            self.sig_nbytes, self.other_nbytes = 96, 192
+        elif name == "G2":
+            self.sig, self.other = _g2_ops, _g1_ops
+            self.hash_to_sig, self.hash_to_other = hash_to_g2, hash_to_g1
+            self.sig_to_bytes, self.other_to_bytes = (
+                ser.g2_to_bytes,
+                ser.g1_to_bytes,
+            )
+            self.sig_from_bytes, self.other_from_bytes = (
+                ser.g2_from_bytes,
+                ser.g1_from_bytes,
+            )
+            self.sig_nbytes, self.other_nbytes = 192, 96
+        else:
+            raise GeneralError("unknown signature group %r" % name)
+        self.name = name
+
+    def pairing_check(self, pairs):
+        """prod e(sig_i, other_i) == 1, with arguments mapped to the concrete
+        (G1, G2) order the pairing needs."""
+        if self.name == "G1":
+            ordered = [(s, o) for s, o in pairs]
+        else:
+            ordered = [(o, s) for s, o in pairs]
+        return _raw_pairing_check(ordered)
+
+
+SIGNATURES_IN_G1 = GroupContext("G1")
+SIGNATURES_IN_G2 = GroupContext("G2")
+DEFAULT_CTX = SIGNATURES_IN_G1
+
+
+class Params:
+    """Setup output: g in SignatureGroup, g_tilde in OtherGroup, one h per
+    message (signature.rs:13-37)."""
+
+    def __init__(self, g, g_tilde, h, ctx=DEFAULT_CTX):
+        self.g = g
+        self.g_tilde = g_tilde
+        self.h = list(h)
+        self.ctx = ctx
+
+    @classmethod
+    def new(cls, msg_count, label, ctx=DEFAULT_CTX):
+        """Deterministic params from a label with the reference's exact
+        domain-separating suffixes (signature.rs:23-29)."""
+        label = bytes(label)
+        g = ctx.hash_to_sig(label + b" : g")
+        g_tilde = ctx.hash_to_other(label + b" : g_tilde")
+        h = [
+            ctx.hash_to_sig(label + b" : y" + str(i).encode())
+            for i in range(msg_count)
+        ]
+        return cls(g, g_tilde, h, ctx)
+
+    def msg_count(self):
+        return len(self.h)
+
+    def to_bytes(self):
+        out = [self.ctx.sig_to_bytes(self.g), self.ctx.other_to_bytes(self.g_tilde)]
+        out.extend(self.ctx.sig_to_bytes(hi) for hi in self.h)
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, b, ctx=DEFAULT_CTX):
+        head = ctx.sig_nbytes + ctx.other_nbytes
+        if len(b) < head or (len(b) - head) % ctx.sig_nbytes:
+            raise DeserializationError("malformed Params encoding")
+        g = ctx.sig_from_bytes(b[: ctx.sig_nbytes])
+        g_tilde = ctx.other_from_bytes(b[ctx.sig_nbytes : head])
+        h = [
+            ctx.sig_from_bytes(b[o : o + ctx.sig_nbytes])
+            for o in range(head, len(b), ctx.sig_nbytes)
+        ]
+        return cls(g, g_tilde, h, ctx)
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Params)
+            and self.g == other.g
+            and self.g_tilde == other.g_tilde
+            and self.h == other.h
+            and self.ctx.name == other.ctx.name
+        )

@@ -1,0 +1,36 @@
+"""Proof of knowledge of a credential with selective disclosure — the
+"Show"/"ShowVerify" step.
+
+The reference's pok_sig.rs is a 6-line delegation to ps_sig plus a test
+(pok_sig.rs:1-6); here the protocol lives in `coconut_tpu.ps` and this module
+provides the convenience pair the README's 8-step flow ends with
+(README.md:141-172)."""
+
+from .ps import PoKOfSignature, PoKOfSignatureProof  # noqa: F401 (re-export)
+from .signature import fiat_shamir_challenge
+
+
+def show(sig, vk, params, messages, revealed_msg_indices, blindings=None):
+    """Prover side: returns (proof, challenge, revealed_msgs). Non-interactive
+    via Fiat-Shamir over the PoK transcript (pok_sig.rs:85-95)."""
+    pok = PoKOfSignature(
+        sig, vk, params, messages,
+        blindings=blindings,
+        revealed_msg_indices=revealed_msg_indices,
+    )
+    challenge = fiat_shamir_challenge(pok.to_bytes())
+    proof = pok.gen_proof(challenge)
+    revealed_msgs = {i: messages[i] for i in proof.revealed_msg_indices}
+    return proof, challenge, revealed_msgs
+
+
+def show_verify(proof, vk, params, revealed_msgs, challenge=None):
+    """Verifier side. When `challenge` is None the Fiat-Shamir challenge is
+    recomputed from the proof transcript (the secure non-interactive path);
+    passing it explicitly matches the reference's interactive-style tests
+    (pok_sig.rs:94-105)."""
+    if challenge is None:
+        challenge = fiat_shamir_challenge(
+            proof.to_bytes_for_challenge(vk, params)
+        )
+    return proof.verify(vk, params, revealed_msgs, challenge)

@@ -1,0 +1,120 @@
+"""Schnorr proof of knowledge of committed values (generic over the group).
+
+Replaces the `impl_PoK_VC!` macro family the reference imports from ps_sig
+(instantiated at signature.rs:73-79; protocol usage signature.rs:227-314,
+338-374). Commit/challenge/response structure:
+
+  commit phase   : prover picks blindings b_i (or accepts supplied ones, which
+                   is how the issuance PoK links the same hidden message
+                   across sub-proofs, signature.rs:233-239,256) and publishes
+                   t = prod base_i ^ b_i.
+  response phase : response_i = b_i - c * secret_i  (mod r)
+  verification   : t == prod base_i ^ response_i * commitment ^ c
+
+The split into pre-challenge (`ProverCommitting.finish`) and post-challenge
+(`gen_proof`) mirrors the reference so the proof composes with other
+predicates under one Fiat-Shamir challenge (signature.rs:210-215)."""
+
+from .errors import UnequalNoOfBasesExponents
+from .ops.fields import R
+from .sss import rand_fr
+
+
+class ProverCommitting:
+    """Accumulates (base, blinding) pairs; reference: ProverCommitting{G}."""
+
+    def __init__(self, ops, to_bytes):
+        self._ops = ops
+        self._to_bytes = to_bytes
+        self._bases = []
+        self._blindings = []
+
+    def commit(self, base, blinding=None):
+        if blinding is None:
+            blinding = rand_fr()
+        self._bases.append(base)
+        self._blindings.append(blinding)
+        return len(self._bases) - 1
+
+    def finish(self):
+        t = self._ops.msm(self._bases, self._blindings)
+        return ProverCommitted(
+            self._ops, self._to_bytes, self._bases, self._blindings, t
+        )
+
+
+class ProverCommitted:
+    """Commitment-phase output; reference: ProverCommitted{G}."""
+
+    def __init__(self, ops, to_bytes, bases, blindings, t):
+        self._ops = ops
+        self._to_bytes = to_bytes
+        self.bases = bases
+        self.blindings = blindings
+        self.t = t
+
+    def to_bytes(self):
+        """Transcript bytes for Fiat-Shamir: bases then commitment point."""
+        out = [self._to_bytes(b) for b in self.bases]
+        out.append(self._to_bytes(self.t))
+        return b"".join(out)
+
+    def gen_proof(self, challenge, secrets):
+        if len(secrets) != len(self.bases):
+            raise UnequalNoOfBasesExponents(len(self.bases), len(secrets))
+        responses = [
+            (b - challenge * s) % R for b, s in zip(self.blindings, secrets)
+        ]
+        return Proof(self.t, responses)
+
+
+class Proof:
+    """Response-phase output; reference: Proof{G} with fields
+    (commitment=t, responses) — response equality across sub-proofs is
+    checked by the issuance verifier (signature.rs:363-367)."""
+
+    def __init__(self, t, responses):
+        self.t = t
+        self.responses = list(responses)
+
+    def verify(self, ops, bases, commitment, challenge):
+        if len(bases) != len(self.responses):
+            raise UnequalNoOfBasesExponents(len(bases), len(self.responses))
+        lhs = ops.add(
+            ops.msm(bases, self.responses), ops.mul(commitment, challenge)
+        )
+        return lhs == self.t
+
+    def to_bytes_with_bases(self, to_bytes, bases):
+        """Reconstruct the commit-phase transcript bytes (bases || t) so a
+        Fiat-Shamir verifier can recompute the challenge — an addition over
+        the reference, whose tests pass the challenge out-of-band."""
+        out = [to_bytes(b) for b in bases]
+        out.append(to_bytes(self.t))
+        return b"".join(out)
+
+    def to_bytes(self, elem_to_bytes):
+        """Canonical wire encoding: t || count(4B) || responses (32B each)."""
+        out = [elem_to_bytes(self.t), len(self.responses).to_bytes(4, "big")]
+        out.extend(r.to_bytes(32, "big") for r in self.responses)
+        return b"".join(out)
+
+    @classmethod
+    def read_from(cls, b, offset, elem_from_bytes, elem_size):
+        """Parse one Proof at `offset`; returns (proof, next_offset)."""
+        from .errors import DeserializationError
+        from .ops.serialize import fr_from_bytes
+
+        if len(b) < offset + elem_size + 4:
+            raise DeserializationError("truncated PoK proof encoding")
+        t = elem_from_bytes(b[offset : offset + elem_size])
+        offset += elem_size
+        n = int.from_bytes(b[offset : offset + 4], "big")
+        offset += 4
+        if len(b) < offset + 32 * n:
+            raise DeserializationError("truncated PoK proof responses")
+        responses = [
+            fr_from_bytes(b[offset + 32 * i : offset + 32 * (i + 1)])
+            for i in range(n)
+        ]
+        return cls(t, responses), offset + 32 * n

@@ -1,0 +1,237 @@
+"""Pointcheval-Sanders signature layer: verification and proof of knowledge
+of a signature with selective disclosure.
+
+Replaces the reference's external `ps_sig` crate (Cargo.toml:21-22). Because
+this framework owns both layers, the reference's clone-transforms
+`transform_to_PS_{params,verkey,sig}` (signature.rs:81-104, marked TODO
+there) become identity — coconut types are used directly.
+
+Surface parity (SURVEY.md §2.2): `PSSignature::verify` (reached via
+signature.rs:477), `PoKOfSignature::{init,to_bytes,gen_proof}` and
+`Proof::verify` (pok_sig.rs:85-105).
+
+Verification hot path: one (msg_count+1)-term OtherGroup MSM plus a
+2-pairing product with shared final exponentiation — exactly what the
+`CurveBackend` seam batches onto TPU (BASELINE.json north star).
+"""
+
+from .errors import PSError, UnsupportedNoOfMessages
+from .pok_vc import ProverCommitting
+from .sss import rand_fr
+
+
+def prepare_verify_statement(messages, vk, params):
+    """The OtherGroup accumulator X_tilde * prod Y_tilde_j^{m_j}.
+
+    Split out so batch backends can compute it per credential; reference:
+    inferred MSM inside PSSignature::verify (SURVEY.md §3.4)."""
+    if len(messages) != len(vk.Y_tilde):
+        raise UnsupportedNoOfMessages(len(vk.Y_tilde), len(messages))
+    ops = params.ctx.other
+    return ops.add(vk.X_tilde, ops.msm(vk.Y_tilde, list(messages)))
+
+
+def ps_verify(sig, messages, vk, params):
+    """PS verification: e(sigma_1, X_tilde * prod Y_tilde_j^{m_j}) ==
+    e(sigma_2, g_tilde), rejecting the forgeable sigma_1 == identity
+    (signature.rs:472-478 -> ps_sig)."""
+    if sig.sigma_1 is None:
+        return False
+    acc = prepare_verify_statement(messages, vk, params)
+    ctx = params.ctx
+    return ctx.pairing_check(
+        [(sig.sigma_1, acc), (ctx.sig.neg(sig.sigma_2), params.g_tilde)]
+    )
+
+
+def batch_verify(sigs, messages_list, vk, params, backend=None):
+    """Per-credential verification booleans for a batch under one verkey.
+
+    `backend=None` runs the sequential reference path; a `CurveBackend`
+    (e.g. the JAX/TPU backend) executes the same math batched. This is the
+    north-star entry point (BASELINE.json configs 2 and 5)."""
+    if len(sigs) != len(messages_list):
+        raise PSError(
+            "batch size mismatch: %d sigs, %d message vectors"
+            % (len(sigs), len(messages_list))
+        )
+    if backend is not None:
+        return backend.batch_verify(sigs, messages_list, vk, params)
+    return [
+        ps_verify(s, m, vk, params) for s, m in zip(sigs, messages_list)
+    ]
+
+
+class PoKOfSignature:
+    """Commitment phase of the selective-disclosure proof ("Show" from the
+    Coconut paper; reference surface pok_sig.rs:85-95).
+
+    Re-randomizes the credential — sigma_1' = sigma_1^r,
+    sigma_2' = (sigma_2 * sigma_1^t)^r — then proves knowledge of t and the
+    hidden messages in J = g_tilde^t * prod_{hidden j} Y_tilde_j^{m_j}.
+    """
+
+    def __init__(self, sig, vk, params, messages, blindings=None,
+                 revealed_msg_indices=None):
+        revealed = set(revealed_msg_indices or ())
+        if len(messages) != len(vk.Y_tilde):
+            raise UnsupportedNoOfMessages(len(vk.Y_tilde), len(messages))
+        for i in revealed:
+            if not 0 <= i < len(messages):
+                raise PSError("revealed index %d out of range" % i)
+        hidden = [i for i in range(len(messages)) if i not in revealed]
+        if blindings is not None and len(blindings) != len(hidden):
+            raise PSError(
+                "need %d blindings for hidden messages, got %d"
+                % (len(hidden), len(blindings))
+            )
+        ctx = params.ctx
+        r = rand_fr()
+        t = rand_fr()
+        self.sigma_prime_1 = ctx.sig.mul(sig.sigma_1, r)
+        self.sigma_prime_2 = ctx.sig.mul(
+            ctx.sig.add(sig.sigma_2, ctx.sig.mul(sig.sigma_1, t)), r
+        )
+        bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
+        secrets = [t] + [messages[i] for i in hidden]
+        committing = ProverCommitting(ctx.other, ctx.other_to_bytes)
+        committing.commit(params.g_tilde, None)
+        for k, i in enumerate(hidden):
+            committing.commit(
+                vk.Y_tilde[i], None if blindings is None else blindings[k]
+            )
+        self.J = ctx.other.msm(bases, secrets)
+        self._committed = committing.finish()
+        self._secrets = secrets
+        self._ctx = ctx
+        self.revealed_msg_indices = revealed
+
+    def to_bytes(self):
+        """Fiat-Shamir transcript (challenge input; pok_sig.rs:94)."""
+        ctx = self._ctx
+        return (
+            ctx.sig_to_bytes(self.sigma_prime_1)
+            + ctx.sig_to_bytes(self.sigma_prime_2)
+            + ctx.other_to_bytes(self.J)
+            + self._committed.to_bytes()
+        )
+
+    def gen_proof(self, challenge):
+        proof_vc = self._committed.gen_proof(challenge, self._secrets)
+        return PoKOfSignatureProof(
+            self.sigma_prime_1,
+            self.sigma_prime_2,
+            self.J,
+            proof_vc,
+            self.revealed_msg_indices,
+        )
+
+
+class PoKOfSignatureProof:
+    """Response phase; verifier surface matches ps_sig's
+    `Proof::verify(vk, params, revealed_msgs, challenge)` (pok_sig.rs:103-105).
+    """
+
+    def __init__(self, sigma_prime_1, sigma_prime_2, J, proof_vc,
+                 revealed_msg_indices):
+        self.sigma_prime_1 = sigma_prime_1
+        self.sigma_prime_2 = sigma_prime_2
+        self.J = J
+        self.proof_vc = proof_vc
+        self.revealed_msg_indices = set(revealed_msg_indices)
+
+    def _bases(self, vk, params):
+        hidden = [
+            i
+            for i in range(len(vk.Y_tilde))
+            if i not in self.revealed_msg_indices
+        ]
+        return [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
+
+    def to_bytes(self, ctx):
+        """Canonical wire encoding (the struct sent prover -> verifier)."""
+        out = [
+            ctx.sig_to_bytes(self.sigma_prime_1),
+            ctx.sig_to_bytes(self.sigma_prime_2),
+            ctx.other_to_bytes(self.J),
+            self.proof_vc.to_bytes(ctx.other_to_bytes),
+            len(self.revealed_msg_indices).to_bytes(4, "big"),
+        ]
+        out.extend(
+            i.to_bytes(4, "big") for i in sorted(self.revealed_msg_indices)
+        )
+        return b"".join(out)
+
+    @classmethod
+    def from_bytes(cls, b, ctx):
+        from .errors import DeserializationError
+        from .pok_vc import Proof
+
+        n = ctx.sig_nbytes
+        if len(b) < 2 * n + ctx.other_nbytes:
+            raise DeserializationError("malformed PoKOfSignatureProof")
+        s1 = ctx.sig_from_bytes(b[:n])
+        s2 = ctx.sig_from_bytes(b[n : 2 * n])
+        o = 2 * n
+        J = ctx.other_from_bytes(b[o : o + ctx.other_nbytes])
+        o += ctx.other_nbytes
+        proof_vc, o = Proof.read_from(
+            b, o, ctx.other_from_bytes, ctx.other_nbytes
+        )
+        if len(b) < o + 4:
+            raise DeserializationError("malformed PoKOfSignatureProof")
+        k = int.from_bytes(b[o : o + 4], "big")
+        o += 4
+        if len(b) != o + 4 * k:
+            raise DeserializationError("malformed PoKOfSignatureProof")
+        revealed = {
+            int.from_bytes(b[o + 4 * i : o + 4 * (i + 1)], "big")
+            for i in range(k)
+        }
+        if len(revealed) != k:
+            raise DeserializationError("duplicate revealed indices")
+        return cls(s1, s2, J, proof_vc, revealed)
+
+    def to_bytes_for_challenge(self, vk, params):
+        """Reconstruct the prover's transcript bytes so a Fiat-Shamir verifier
+        recomputes (rather than trusts) the challenge — rebuild addition over
+        the reference's out-of-band challenge passing."""
+        ctx = params.ctx
+        return (
+            ctx.sig_to_bytes(self.sigma_prime_1)
+            + ctx.sig_to_bytes(self.sigma_prime_2)
+            + ctx.other_to_bytes(self.J)
+            + self.proof_vc.to_bytes_with_bases(
+                ctx.other_to_bytes, self._bases(vk, params)
+            )
+        )
+
+    def verify(self, vk, params, revealed_msgs, challenge):
+        """Check the Schnorr relation on J, then the pairing
+        e(sigma_1', J * X_tilde * prod_{revealed} Y_tilde_i^{m_i}) ==
+        e(sigma_2', g_tilde)."""
+        ctx = params.ctx
+        if self.sigma_prime_1 is None:
+            return False
+        if set(revealed_msgs.keys()) != self.revealed_msg_indices:
+            raise PSError("revealed messages do not match proof's indices")
+        if not self.proof_vc.verify(
+            ctx.other, self._bases(vk, params), self.J, challenge
+        ):
+            return False
+        acc = ctx.other.add(self.J, vk.X_tilde)
+        if revealed_msgs:
+            idxs = sorted(revealed_msgs)
+            acc = ctx.other.add(
+                acc,
+                ctx.other.msm(
+                    [vk.Y_tilde[i] for i in idxs],
+                    [revealed_msgs[i] for i in idxs],
+                ),
+            )
+        return ctx.pairing_check(
+            [
+                (self.sigma_prime_1, acc),
+                (ctx.sig.neg(self.sigma_prime_2), params.g_tilde),
+            ]
+        )

@@ -1,0 +1,246 @@
+"""Secret sharing over Fr: polynomials, Lagrange, Shamir, Pedersen VSS and
+dealerless Pedersen DVSS.
+
+Replaces the reference's external `secret_sharing` crate (git rev 6bca50d,
+Cargo.toml:14). Surface matches the call sites cataloged in SURVEY.md §2.2:
+`Polynomial::lagrange_basis_at_0` (signature.rs:460,502; keygen.rs:270),
+`get_shared_secret` / `reconstruct_secret` (keygen.rs:58,248),
+`PedersenVSS::{gens,deal,verify_share}` (keygen.rs:93-94,317,334-351), and
+`PedersenDVSSParticipant` (keygen.rs:136-162).
+"""
+
+import secrets
+
+from .errors import GeneralError
+from .ops.curve import g1 as _g1_ops
+from .ops.fields import R, fr_inv, fr_mul, fr_sub
+from .ops.hashing import hash_to_g1
+
+
+def rand_fr():
+    """Uniform scalar in [0, r) from OS entropy (reference: FieldElement::random)."""
+    return secrets.randbelow(R)
+
+
+# --- Polynomials -----------------------------------------------------------
+
+
+def poly_random(degree):
+    """Random polynomial of the given degree (degree+1 coefficients, a0 first)."""
+    return [rand_fr() for _ in range(degree + 1)]
+
+
+def poly_eval(coeffs, x):
+    """Horner evaluation at integer x, in Fr."""
+    acc = 0
+    for c in reversed(coeffs):
+        acc = (acc * x + c) % R
+    return acc
+
+
+def lagrange_basis_at_0(ids, my_id):
+    """Lagrange basis polynomial l_{my_id}(0) over the interpolation set `ids`.
+
+    Reference: Polynomial::lagrange_basis_at_0 (used at signature.rs:460,502).
+    Supports arbitrary (gap-containing) 1-based id sets — the edge case the
+    reference tests hardest (signature.rs:711-822).
+    """
+    ids = set(ids)
+    if my_id not in ids:
+        raise GeneralError("id %d not in interpolation set %s" % (my_id, sorted(ids)))
+    if 0 in ids:
+        raise GeneralError("signer ids must be nonzero (1-based)")
+    num, den = 1, 1
+    for j in ids:
+        if j == my_id:
+            continue
+        num = num * (j % R) % R
+        den = den * ((j - my_id) % R) % R
+    return fr_mul(num, fr_inv(den))
+
+
+# --- Shamir secret sharing -------------------------------------------------
+
+
+def get_shared_secret(threshold, total):
+    """Deal a fresh random secret into `total` Shamir shares with the given
+    reconstruction `threshold`. Returns (secret, {id: share}) with 1-based ids
+    (reference: keygen.rs:58)."""
+    if not 0 < threshold <= total:
+        raise GeneralError(
+            "invalid threshold %d for total %d" % (threshold, total)
+        )
+    coeffs = poly_random(threshold - 1)
+    return coeffs[0], {i: poly_eval(coeffs, i) for i in range(1, total + 1)}
+
+
+def reconstruct_secret(threshold, shares):
+    """Lagrange-interpolate the secret at 0 from any `threshold` shares
+    (reference: keygen.rs:248)."""
+    if len(shares) < threshold:
+        raise GeneralError(
+            "need %d shares to reconstruct, got %d" % (threshold, len(shares))
+        )
+    use = dict(list(sorted(shares.items()))[:threshold])
+    acc = 0
+    for i, s in use.items():
+        acc = (acc + lagrange_basis_at_0(use.keys(), i) * s) % R
+    return acc
+
+
+# --- Pedersen verifiable secret sharing ------------------------------------
+
+
+class PedersenVSS:
+    """Pedersen VSS with commitments in a (configurable) commitment group.
+
+    The reference fixes the commitment group to G1 (keygen.rs:5,79-80); we
+    keep that default but route through CurveOps so the group-assignment
+    config stays single-source-of-truth (SURVEY.md §1 wiring quirk).
+    """
+
+    ops = _g1_ops
+
+    @classmethod
+    def gens(cls, label):
+        """Two independent generators derived from a label (keygen.rs:93 via
+        PedersenVSS::gens)."""
+        return (
+            hash_to_g1(bytes(label) + b" : g"),
+            hash_to_g1(bytes(label) + b" : h"),
+        )
+
+    @classmethod
+    def deal(cls, threshold, total, g, h):
+        """Deal a secret with blinding: returns
+        (secret, blind_secret, comm_coeffs {j: g^{a_j} h^{b_j}},
+         s_shares {id: F(id)}, t_shares {id: G(id)})  — keygen.rs:93-94."""
+        if not 0 < threshold <= total:
+            raise GeneralError(
+                "invalid threshold %d for total %d" % (threshold, total)
+            )
+        f_coeffs = poly_random(threshold - 1)
+        g_coeffs = poly_random(threshold - 1)
+        comm_coeffs = {
+            j: cls.ops.add(
+                cls.ops.mul(g, f_coeffs[j]), cls.ops.mul(h, g_coeffs[j])
+            )
+            for j in range(threshold)
+        }
+        s_shares = {i: poly_eval(f_coeffs, i) for i in range(1, total + 1)}
+        t_shares = {i: poly_eval(g_coeffs, i) for i in range(1, total + 1)}
+        return f_coeffs[0], g_coeffs[0], comm_coeffs, s_shares, t_shares
+
+    @classmethod
+    def verify_share(cls, threshold, share_id, share, comm_coeffs, g, h):
+        """Check g^s h^t == prod_j comm_coeffs[j]^(id^j) — the malicious-dealer
+        detection the protocol's fault tolerance rests on (README.md:52-68,
+        keygen.rs:334-351)."""
+        s, t = share
+        lhs = cls.ops.add(cls.ops.mul(g, s), cls.ops.mul(h, t))
+        bases, exps = [], []
+        e = 1
+        for j in range(threshold):
+            bases.append(comm_coeffs[j])
+            exps.append(e)
+            e = e * share_id % R
+        return lhs == cls.ops.msm(bases, exps)
+
+
+# --- Pedersen decentralized (dealerless) VSS --------------------------------
+
+
+class PedersenDVSSParticipant:
+    """One participant in the dealerless protocol: deal own secret, exchange
+    shares pairwise, verify, additively combine (reference surface:
+    keygen.rs:136-162; protocol driver pattern keygen.rs:126-165).
+
+    Unlike the reference — where the driver is `#[cfg(test)]`-only — both the
+    participant and the round drivers below are library code.
+    """
+
+    def __init__(self, participant_id, threshold, total, g, h):
+        self.id = participant_id
+        self.threshold = threshold
+        self.total = total
+        (
+            self.secret,
+            self.blind_secret,
+            self.comm_coeffs,
+            self.s_shares,
+            self.t_shares,
+        ) = PedersenVSS.deal(threshold, total, g, h)
+        # shares of *other* participants' secrets addressed to us
+        self._received = {}  # from_id -> (s, t)
+        self._received_comms = {}  # from_id -> comm_coeffs
+        self.secret_share = None
+        self.t_secret_share = None
+        self.final_comm_coeffs = None
+
+    def received_share(self, from_id, comm_coeffs, share, threshold, total, g, h):
+        """Verify and store a share of `from_id`'s secret, evaluated at our id."""
+        if from_id == self.id:
+            raise GeneralError("participant %d received its own share" % self.id)
+        if from_id in self._received:
+            raise GeneralError(
+                "participant %d already has a share from %d" % (self.id, from_id)
+            )
+        if not PedersenVSS.verify_share(
+            threshold, self.id, share, comm_coeffs, g, h
+        ):
+            raise GeneralError(
+                "share from participant %d failed verification at %d"
+                % (from_id, self.id)
+            )
+        self._received[from_id] = share
+        self._received_comms[from_id] = comm_coeffs
+
+    def compute_final_comm_coeffs_and_shares(self, threshold, total, g, h):
+        """Sum own + received shares into this participant's share of the
+        distributed secret; combine coefficient commitments for later checks."""
+        if len(self._received) != total - 1:
+            raise GeneralError(
+                "participant %d has %d of %d expected shares"
+                % (self.id, len(self._received), total - 1)
+            )
+        s_acc = self.s_shares[self.id]
+        t_acc = self.t_shares[self.id]
+        for s, t in self._received.values():
+            s_acc = (s_acc + s) % R
+            t_acc = (t_acc + t) % R
+        self.secret_share = s_acc
+        self.t_secret_share = t_acc
+        final = {}
+        for j in range(threshold):
+            acc = self.comm_coeffs[j]
+            for comms in self._received_comms.values():
+                acc = PedersenVSS.ops.add(acc, comms[j])
+            final[j] = acc
+        self.final_comm_coeffs = final
+
+
+def share_secret_dvss(threshold, total, g, h):
+    """Full dealerless 3-round protocol, simulated in-process: deal, pairwise
+    exchange + verify, finalize. Mirrors the reference driver
+    `share_secret_for_testing` (keygen.rs:126-165) as library code."""
+    participants = [
+        PedersenDVSSParticipant(i, threshold, total, g, h)
+        for i in range(1, total + 1)
+    ]
+    for i in range(total):
+        for j in range(total):
+            if i == j:
+                continue
+            sender = participants[j]
+            participants[i].received_share(
+                sender.id,
+                sender.comm_coeffs,
+                (sender.s_shares[i + 1], sender.t_shares[i + 1]),
+                threshold,
+                total,
+                g,
+                h,
+            )
+    for p in participants:
+        p.compute_final_comm_coeffs_and_shares(threshold, total, g, h)
+    return participants

@@ -1,0 +1,493 @@
+"""Protocol-layer tests: the reference's full test matrix (SURVEY.md §4) —
+every property under all three keygen modes, id-gap and cross-subset
+aggregation — plus the negative and serialization coverage the reference
+lacked (SURVEY.md §4 'gaps to improve on')."""
+
+import pytest
+
+from coconut_tpu.elgamal import elgamal_decrypt, elgamal_encrypt, elgamal_keygen
+from coconut_tpu.errors import GeneralError, UnsupportedNoOfMessages
+from coconut_tpu.keygen import (
+    dvss_keygen,
+    trusted_party_PVSS_keygen,
+    trusted_party_SSS_keygen,
+)
+from coconut_tpu.params import DEFAULT_CTX, SIGNATURES_IN_G2, Params
+from coconut_tpu.pok_sig import show, show_verify
+from coconut_tpu.ps import batch_verify
+from coconut_tpu.signature import (
+    BlindSignature,
+    Signature,
+    SignatureRequest,
+    SignatureRequestPoK,
+    Verkey,
+    fiat_shamir_challenge,
+)
+from coconut_tpu.sss import (
+    PedersenVSS,
+    lagrange_basis_at_0,
+    rand_fr,
+    reconstruct_secret,
+)
+
+THRESHOLD, TOTAL = 3, 5
+
+
+@pytest.fixture(scope="module")
+def params7():
+    return Params.new(7, b"test")
+
+
+@pytest.fixture(scope="module")
+def params6():
+    return Params.new(6, b"test")
+
+
+@pytest.fixture(scope="module")
+def pvss_gens():
+    return PedersenVSS.gens(b"testPVSS")
+
+
+# --- shared check helpers (reference: signature.rs:537-638) -----------------
+
+
+def check_key_aggregation(threshold, msg_count, secret_x, secret_y, signers, params):
+    aggr_vk = Verkey.aggregate(
+        threshold,
+        [(s.id, s.verkey) for s in signers[:threshold]],
+        params.ctx,
+    )
+    assert aggr_vk.X_tilde == params.ctx.other.mul(params.g_tilde, secret_x)
+    for i in range(msg_count):
+        assert aggr_vk.Y_tilde[i] == params.ctx.other.mul(
+            params.g_tilde, secret_y[i]
+        )
+
+
+def check_reconstructed_keys(threshold, msg_count, secret_x, secret_y, signers, params):
+    """keygen.rs:231-297: reconstruct master secret from t shares and
+    re-derive the master pubkey by Lagrange-MSM."""
+    shares_x = {s.id: s.sigkey.x for s in signers[:threshold]}
+    assert reconstruct_secret(threshold, shares_x) == secret_x
+    for j in range(msg_count):
+        shares_y = {s.id: s.sigkey.y[j] for s in signers[:threshold]}
+        assert reconstruct_secret(threshold, shares_y) == secret_y[j]
+    ids = {s.id for s in signers[:threshold]}
+    ops = params.ctx.other
+    ls = {i: lagrange_basis_at_0(ids, i) for i in ids}
+    x_recon = ops.msm(
+        [s.verkey.X_tilde for s in signers[:threshold]],
+        [ls[s.id] for s in signers[:threshold]],
+    )
+    assert x_recon == ops.mul(params.g_tilde, secret_x)
+
+
+def run_issuance(threshold, msg_count, count_hidden, signers, params,
+                 signer_indices=None, vk_indices=None):
+    """The full credential lifecycle (signature.rs:582-638). Returns
+    (msgs, aggregated signature, aggregated verkey)."""
+    msgs = [rand_fr() for _ in range(msg_count)]
+    elg_sk, elg_pk = elgamal_keygen(params.ctx.sig, params.g)
+    sig_req, randomness = SignatureRequest.new(msgs, count_hidden, elg_pk, params)
+    pok = SignatureRequestPoK.init(sig_req, elg_pk, params)
+    challenge = fiat_shamir_challenge(pok.to_bytes())
+    hidden = msgs[:count_hidden]
+    proof = pok.gen_proof(hidden, randomness, elg_sk, challenge)
+
+    signer_indices = signer_indices or list(range(threshold))
+    unblinded = []
+    for idx in signer_indices:
+        s = signers[idx]
+        # each signer verifies the PoK before signing (signature.rs:613-616),
+        # recomputing the Fiat-Shamir challenge itself
+        chal = fiat_shamir_challenge(
+            proof.to_bytes_for_challenge(sig_req, elg_pk, params)
+        )
+        assert chal == challenge
+        assert proof.verify(sig_req, elg_pk, chal, params)
+        blind_sig = BlindSignature.new(sig_req, s.sigkey, params)
+        unblinded_sig = blind_sig.unblind(elg_sk, params.ctx)
+        assert unblinded_sig.verify(msgs, s.verkey, params)
+        unblinded.append((s.id, unblinded_sig))
+
+    aggr_sig = Signature.aggregate(threshold, unblinded, params.ctx)
+    vk_indices = vk_indices or signer_indices
+    aggr_vk = Verkey.aggregate(
+        threshold,
+        [(signers[i].id, signers[i].verkey) for i in vk_indices],
+        params.ctx,
+    )
+    assert aggr_sig.verify(msgs, aggr_vk, params)
+    return msgs, aggr_sig, aggr_vk
+
+
+# --- elgamal (elgamal.rs tests) --------------------------------------------
+
+
+@pytest.mark.parametrize("ctx", [DEFAULT_CTX, SIGNATURES_IN_G2])
+def test_elgamal_roundtrip(ctx):
+    ops = ctx.sig
+    g = ctx.hash_to_sig(b"elgamal test base")
+    sk, pk = elgamal_keygen(ops, g)
+    msg = ops.mul(g, rand_fr())
+    c1, c2, _k = elgamal_encrypt(ops, g, pk, msg)
+    assert elgamal_decrypt(ops, c1, c2, sk) == msg
+
+
+# --- keygen (keygen.rs tests) ----------------------------------------------
+
+
+def test_keygen_shapes(params7):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params7)
+    assert len(signers) == TOTAL
+    for i, s in enumerate(signers):
+        assert s.id == i + 1
+        assert len(s.sigkey.y) == 7
+        assert len(s.verkey.Y_tilde) == 7
+
+
+def test_keygen_reconstruction_shamir(params7):
+    sx, sy, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params7)
+    check_reconstructed_keys(THRESHOLD, 7, sx, sy, signers, params7)
+
+
+def test_keygen_reconstruction_pvss(params7, pvss_gens):
+    g, h = pvss_gens
+    out = trusted_party_PVSS_keygen(THRESHOLD, TOTAL, params7, g, h)
+    # every signer verifies its share against the dealer's commitments
+    # (keygen.rs:333-352)
+    for i in range(1, TOTAL + 1):
+        assert PedersenVSS.verify_share(
+            THRESHOLD,
+            i,
+            (out.x_shares[i], out.x_t_shares[i]),
+            out.comm_coeff_x,
+            g,
+            h,
+        )
+        for j in range(7):
+            assert PedersenVSS.verify_share(
+                THRESHOLD,
+                i,
+                (out.y_shares[j][i], out.y_t_shares[j][i]),
+                out.comm_coeff_y[j],
+                g,
+                h,
+            )
+    check_reconstructed_keys(
+        THRESHOLD, 7, out.secret_x, out.secret_y, out.signers, params7
+    )
+
+
+def test_keygen_reconstruction_dvss(params7, pvss_gens):
+    g, h = pvss_gens
+    sx, sy, signers = dvss_keygen(THRESHOLD, TOTAL, params7, g, h)
+    check_reconstructed_keys(THRESHOLD, 7, sx, sy, signers, params7)
+
+
+# --- verkey aggregation (signature.rs:640-666,710-759) ----------------------
+
+
+def test_verkey_aggregation_shamir(params7):
+    sx, sy, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params7)
+    check_key_aggregation(THRESHOLD, 7, sx, sy, signers, params7)
+
+
+def test_verkey_aggregation_pvss(params7, pvss_gens):
+    g, h = pvss_gens
+    out = trusted_party_PVSS_keygen(THRESHOLD, TOTAL, params7, g, h)
+    check_key_aggregation(
+        THRESHOLD, 7, out.secret_x, out.secret_y, out.signers, params7
+    )
+
+
+@pytest.mark.parametrize("mode", ["shamir", "pvss"])
+def test_verkey_aggregation_gaps_in_ids(params7, pvss_gens, mode):
+    if mode == "shamir":
+        sx, sy, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params7)
+    else:
+        g, h = pvss_gens
+        out = trusted_party_PVSS_keygen(THRESHOLD, TOTAL, params7, g, h)
+        sx, sy, signers = out.secret_x, out.secret_y, out.signers
+    keys = [(signers[i].id, signers[i].verkey) for i in (0, 2, 4)]
+    aggr_vk = Verkey.aggregate(THRESHOLD, keys, params7.ctx)
+    assert aggr_vk.X_tilde == params7.ctx.other.mul(params7.g_tilde, sx)
+    for i in range(7):
+        assert aggr_vk.Y_tilde[i] == params7.ctx.other.mul(
+            params7.g_tilde, sy[i]
+        )
+
+
+# --- full lifecycle under all three keygen modes (signature.rs:668-708) -----
+
+
+def test_sign_verify_shamir(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    run_issuance(THRESHOLD, 6, 2, signers, params6)
+
+
+def test_sign_verify_pvss(params6, pvss_gens):
+    g, h = pvss_gens
+    out = trusted_party_PVSS_keygen(THRESHOLD, TOTAL, params6, g, h)
+    run_issuance(THRESHOLD, 6, 2, out.signers, params6)
+
+
+def test_sign_verify_dvss(params6, pvss_gens):
+    g, h = pvss_gens
+    _, _, signers = dvss_keygen(THRESHOLD, TOTAL, params6, g, h)
+    run_issuance(THRESHOLD, 6, 2, signers, params6)
+
+
+def test_sign_verify_different_vk_subset(params6):
+    """Sign with signers {1,3,5}, aggregate verkey from {2,4,6}
+    (signature.rs:761-822)."""
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, 6, params6)
+    run_issuance(
+        THRESHOLD, 6, 2, signers, params6,
+        signer_indices=[0, 2, 4], vk_indices=[1, 3, 5],
+    )
+
+
+def test_sign_verify_no_hidden(params6):
+    """count_hidden = 0: no ciphertexts, empty hidden-message sub-proofs."""
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    run_issuance(THRESHOLD, 6, 0, signers, params6)
+
+
+def test_sign_verify_all_hidden(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    run_issuance(THRESHOLD, 6, 6, signers, params6)
+
+
+# --- selective disclosure (pok_sig.rs:18-106) -------------------------------
+
+
+def test_pok_sig_selective_disclosure(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs, aggr_sig, aggr_vk = run_issuance(THRESHOLD, 6, 2, signers, params6)
+    proof, challenge, revealed = show(
+        aggr_sig, aggr_vk, params6, msgs, revealed_msg_indices={3, 5}
+    )
+    assert revealed == {3: msgs[3], 5: msgs[5]}
+    # interactive-style verify with explicit challenge (reference test shape)
+    assert show_verify(proof, aggr_vk, params6, revealed, challenge)
+    # non-interactive verify recomputing the Fiat-Shamir challenge
+    assert show_verify(proof, aggr_vk, params6, revealed)
+
+
+def test_pok_sig_wrong_revealed_value_fails(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs, aggr_sig, aggr_vk = run_issuance(THRESHOLD, 6, 2, signers, params6)
+    proof, challenge, revealed = show(
+        aggr_sig, aggr_vk, params6, msgs, revealed_msg_indices={3, 5}
+    )
+    bad = dict(revealed)
+    bad[3] = (bad[3] + 1) % (2**255)
+    assert not show_verify(proof, aggr_vk, params6, bad, challenge)
+
+
+def test_pok_sig_reveal_nothing_and_everything(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs, aggr_sig, aggr_vk = run_issuance(THRESHOLD, 6, 0, signers, params6)
+    for revealed_set in (set(), set(range(6))):
+        proof, challenge, revealed = show(
+            aggr_sig, aggr_vk, params6, msgs, revealed_msg_indices=revealed_set
+        )
+        assert show_verify(proof, aggr_vk, params6, revealed, challenge)
+
+
+# --- negative tests (rebuild additions; SURVEY.md §4 gaps) ------------------
+
+
+def test_wrong_message_fails_verify(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs, aggr_sig, aggr_vk = run_issuance(THRESHOLD, 6, 2, signers, params6)
+    bad_msgs = list(msgs)
+    bad_msgs[0] = (bad_msgs[0] + 1) % (2**255)
+    assert not aggr_sig.verify(bad_msgs, aggr_vk, params6)
+
+
+def test_below_threshold_aggregation_fails(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs, aggr_sig, aggr_vk = run_issuance(THRESHOLD, 6, 2, signers, params6)
+    with pytest.raises(GeneralError):
+        Signature.aggregate(THRESHOLD, [(1, aggr_sig)], params6.ctx)
+
+
+def test_forged_identity_signature_rejected(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs = [rand_fr() for _ in range(6)]
+    aggr_vk = Verkey.aggregate(
+        THRESHOLD, [(s.id, s.verkey) for s in signers], params6.ctx
+    )
+    forged = Signature(None, None)
+    assert not forged.verify(msgs, aggr_vk, params6)
+
+
+def test_tampered_request_proof_fails(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs = [rand_fr() for _ in range(6)]
+    elg_sk, elg_pk = elgamal_keygen(params6.ctx.sig, params6.g)
+    sig_req, randomness = SignatureRequest.new(msgs, 2, elg_pk, params6)
+    pok = SignatureRequestPoK.init(sig_req, elg_pk, params6)
+    challenge = fiat_shamir_challenge(pok.to_bytes())
+    proof = pok.gen_proof(msgs[:2], randomness, elg_sk, challenge)
+    # flip a response in the commitment sub-proof: linkage check must fail
+    proof.proof_commitment.responses[0] = (
+        proof.proof_commitment.responses[0] + 1
+    ) % (2**255)
+    assert not proof.verify(sig_req, elg_pk, challenge, params6)
+    # wrong challenge also fails
+    assert not proof.verify(sig_req, elg_pk, (challenge + 1) % 2**255, params6)
+
+
+def test_message_count_mismatch_raises(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs = [rand_fr() for _ in range(5)]
+    elg_sk, elg_pk = elgamal_keygen(params6.ctx.sig, params6.g)
+    with pytest.raises(UnsupportedNoOfMessages):
+        SignatureRequest.new(msgs, 2, elg_pk, params6)
+
+
+def test_batch_verify_mixed(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs1, sig1, vk = run_issuance(THRESHOLD, 6, 2, signers, params6)
+    msgs2, sig2, _ = run_issuance(THRESHOLD, 6, 2, signers, params6)
+    bad_msgs = list(msgs2)
+    bad_msgs[1] = (bad_msgs[1] + 1) % (2**255)
+    results = batch_verify(
+        [sig1, sig2, sig2], [msgs1, msgs2, bad_msgs], vk, params6
+    )
+    assert results == [True, True, False]
+
+
+# --- serialization round trips (rebuild additions) --------------------------
+
+
+def test_params_roundtrip(params6):
+    blob = params6.to_bytes()
+    assert Params.from_bytes(blob) == params6
+    # label-determinism: same label -> identical params (signature.rs:22-31)
+    assert Params.new(6, b"test") == params6
+
+
+def test_signature_and_verkey_roundtrip(params6):
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs, aggr_sig, aggr_vk = run_issuance(THRESHOLD, 6, 2, signers, params6)
+    ctx = params6.ctx
+    sig2 = Signature.from_bytes(aggr_sig.to_bytes(ctx), ctx)
+    assert sig2 == aggr_sig
+    vk2 = Verkey.from_bytes(aggr_vk.to_bytes(ctx), ctx)
+    assert vk2 == aggr_vk
+    assert sig2.verify(msgs, vk2, params6)
+
+
+def test_signature_request_roundtrip(params6):
+    msgs = [rand_fr() for _ in range(6)]
+    _, elg_pk = elgamal_keygen(params6.ctx.sig, params6.g)
+    sig_req, _ = SignatureRequest.new(msgs, 2, elg_pk, params6)
+    blob = sig_req.to_bytes(params6.ctx)
+    back = SignatureRequest.from_bytes(blob, params6.ctx)
+    assert back.known_messages == sig_req.known_messages
+    assert back.commitment == sig_req.commitment
+    assert back.ciphertexts == sig_req.ciphertexts
+
+
+# --- G2-signature group assignment (reference feature SignatureG2) ----------
+
+
+def test_lifecycle_signatures_in_g2():
+    params = Params.new(4, b"testG2", ctx=SIGNATURES_IN_G2)
+    _, _, signers = trusted_party_SSS_keygen(2, 3, params)
+    run_issuance(2, 4, 1, signers, params)
+
+
+def test_fiat_shamir_binds_statement(params6):
+    """Regression: the issuance PoK challenge must bind the full statement
+    (request bytes incl. ciphertexts + ElGamal pk). Without this, ciphertext
+    sub-proofs are forgeable non-interactively (weak Fiat-Shamir)."""
+    msgs = [rand_fr() for _ in range(6)]
+    elg_sk, elg_pk = elgamal_keygen(params6.ctx.sig, params6.g)
+    sig_req, randomness = SignatureRequest.new(msgs, 2, elg_pk, params6)
+    pok = SignatureRequestPoK.init(sig_req, elg_pk, params6)
+    challenge = fiat_shamir_challenge(pok.to_bytes())
+    proof = pok.gen_proof(msgs[:2], randomness, elg_sk, challenge)
+
+    # splice a different ciphertext into the request: the recomputed
+    # Fiat-Shamir challenge must change, so the old proof cannot be replayed
+    tampered = SignatureRequest(
+        sig_req.known_messages,
+        sig_req.commitment,
+        [(sig_req.ciphertexts[0][1], sig_req.ciphertexts[0][0])]
+        + sig_req.ciphertexts[1:],
+    )
+    chal_honest = fiat_shamir_challenge(
+        proof.to_bytes_for_challenge(sig_req, elg_pk, params6)
+    )
+    chal_tampered = fiat_shamir_challenge(
+        proof.to_bytes_for_challenge(tampered, elg_pk, params6)
+    )
+    assert chal_honest == challenge
+    assert chal_tampered != challenge
+    # and a different ElGamal pk changes the challenge too
+    _, other_pk = elgamal_keygen(params6.ctx.sig, params6.g)
+    assert (
+        fiat_shamir_challenge(
+            proof.to_bytes_for_challenge(sig_req, other_pk, params6)
+        )
+        != challenge
+    )
+
+
+def test_malformed_subproof_shapes_rejected(params6):
+    """Regression: truncated ciphertext sub-proofs must be a clean False,
+    not an IndexError, in the signer's verification path."""
+    from coconut_tpu.pok_vc import Proof
+
+    msgs = [rand_fr() for _ in range(6)]
+    elg_sk, elg_pk = elgamal_keygen(params6.ctx.sig, params6.g)
+    sig_req, randomness = SignatureRequest.new(msgs, 2, elg_pk, params6)
+    pok = SignatureRequestPoK.init(sig_req, elg_pk, params6)
+    challenge = fiat_shamir_challenge(pok.to_bytes())
+    proof = pok.gen_proof(msgs[:2], randomness, elg_sk, challenge)
+    p1, p2 = proof.proof_ciphertexts[0]
+    proof.proof_ciphertexts[0] = (p1, Proof(p2.t, p2.responses[:1]))
+    assert not proof.verify(sig_req, elg_pk, challenge, params6)
+
+
+def test_proof_wire_roundtrips(params6):
+    """Both proof structs (user->signer and prover->verifier) have canonical
+    wire encodings that verify after a round trip."""
+    from coconut_tpu.ps import PoKOfSignatureProof
+    from coconut_tpu.signature import SignatureRequestProof
+
+    ctx = params6.ctx
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params6)
+    msgs = [rand_fr() for _ in range(6)]
+    elg_sk, elg_pk = elgamal_keygen(ctx.sig, params6.g)
+    sig_req, randomness = SignatureRequest.new(msgs, 2, elg_pk, params6)
+    pok = SignatureRequestPoK.init(sig_req, elg_pk, params6)
+    challenge = fiat_shamir_challenge(pok.to_bytes())
+    proof = pok.gen_proof(msgs[:2], randomness, elg_sk, challenge)
+    back = SignatureRequestProof.from_bytes(proof.to_bytes(ctx), ctx)
+    assert back.verify(sig_req, elg_pk, challenge, params6)
+
+    msgs2, aggr_sig, aggr_vk = run_issuance(THRESHOLD, 6, 2, signers, params6)
+    prf, chal, revealed = show(aggr_sig, aggr_vk, params6, msgs2, {3, 5})
+    back2 = PoKOfSignatureProof.from_bytes(prf.to_bytes(ctx), ctx)
+    assert show_verify(back2, aggr_vk, params6, revealed)
+
+
+def test_malformed_elgamal_subproof_clean_false(params6):
+    """A wrong-arity elgamal-sk sub-proof is a clean False, not an exception."""
+    from coconut_tpu.pok_vc import Proof
+
+    msgs = [rand_fr() for _ in range(6)]
+    elg_sk, elg_pk = elgamal_keygen(params6.ctx.sig, params6.g)
+    sig_req, randomness = SignatureRequest.new(msgs, 2, elg_pk, params6)
+    pok = SignatureRequestPoK.init(sig_req, elg_pk, params6)
+    challenge = fiat_shamir_challenge(pok.to_bytes())
+    proof = pok.gen_proof(msgs[:2], randomness, elg_sk, challenge)
+    sk_proof = proof.proof_elgamal_sk
+    proof.proof_elgamal_sk = Proof(sk_proof.t, sk_proof.responses * 2)
+    assert proof.verify(sig_req, elg_pk, challenge, params6) is False
