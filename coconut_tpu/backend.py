@@ -1,0 +1,130 @@
+"""CurveBackend seam — the batched-execution interface every backend implements.
+
+This is SURVEY.md §7 stage 5 made real: the hot paths of the protocol layer
+(`Signature::verify` reached via reference signature.rs:472-478, and the MSMs
+at signature.rs:465,513,521) route their batch-shaped math through a
+`CurveBackend`, so the same protocol code runs on the pure-Python spec ops or
+on the JAX/TPU limb backend.  The north-star metric (BASELINE.json) is
+`batch_verify` throughput.
+
+Primitives are expressed in concrete (G1, G2) terms; the protocol layer maps
+the abstract SignatureGroup/OtherGroup roles onto them via `GroupContext`
+(params.py), exactly as `GroupContext.pairing_check` already does.
+
+Contract (the differential test harness in tests/test_backends.py enforces
+this for every registered backend):
+  - results are bit-identical to the Python spec ops (`coconut_tpu.ops`) —
+    same affine coordinates, same booleans — on any input the spec accepts;
+  - `pairing_product_is_one` pairs are in (G1 point, G2 point) order;
+  - points are the spec's affine tuples (`None` = identity), scalars are
+    Python ints (canonical Fr residues).
+"""
+
+from .ops import curve as _curve
+from .ops import pairing as _pairing
+from .ops.fields import R
+
+
+class CurveBackend:
+    """Abstract batched curve backend.
+
+    `batch_verify_pairs` has a default composition in terms of the two
+    primitives; fused backends (JAX/TPU) override it to stay on-device.
+    """
+
+    name = "abstract"
+
+    # -- primitives ---------------------------------------------------------
+
+    def msm_g1_shared(self, bases, scalars_batch):
+        """sum_j scalars[i][j] * bases[j] in G1 for each batch row i.
+
+        bases: [k] G1 affine points (shared across the batch);
+        scalars_batch: [B][k] ints. Returns [B] G1 affine points."""
+        raise NotImplementedError
+
+    def msm_g2_shared(self, bases, scalars_batch):
+        """Same as msm_g1_shared, in G2."""
+        raise NotImplementedError
+
+    def pairing_product_is_one(self, pairs_batch):
+        """[B][n] list of (G1 affine, G2 affine) pairs ->
+        [B] bools: prod_j e(P_ij, Q_ij) == 1 per row."""
+        raise NotImplementedError
+
+    # -- composed operations ------------------------------------------------
+
+    def verify_accumulators(self, vk, messages_list, params):
+        """The per-credential OtherGroup accumulator X_tilde * prod Y_j^{m_j}
+        (SURVEY.md §3.4), batched over message vectors with one shared-base
+        MSM: bases [X_tilde, Y_1..Y_q], scalars [1, m_1..m_q]."""
+        bases = [vk.X_tilde] + list(vk.Y_tilde)
+        scalars = [[1] + [m % R for m in msgs] for msgs in messages_list]
+        if params.ctx.name == "G1":
+            return self.msm_g2_shared(bases, scalars)
+        return self.msm_g1_shared(bases, scalars)
+
+    def batch_verify_pairs(self, sig_pairs, params):
+        """[B] rows of [(sig_group_pt, other_group_pt), ...] -> [B] bools,
+        mapping the ctx's group roles onto the concrete (G1, G2) pairing
+        order (cf. GroupContext.pairing_check)."""
+        if params.ctx.name == "G1":
+            ordered = [[(s, o) for s, o in row] for row in sig_pairs]
+        else:
+            ordered = [[(o, s) for s, o in row] for row in sig_pairs]
+        return self.pairing_product_is_one(ordered)
+
+    def batch_verify(self, sigs, messages_list, vk, params):
+        """[B] PS verifications under one verkey -> [B] bools.
+
+        Same math as `ps.ps_verify` (reference: PSSignature::verify reached
+        via signature.rs:477): reject identity sigma_1, then check
+        e(sigma_1, X_tilde * prod Y_j^{m_j}) * e(-sigma_2, g_tilde) == 1."""
+        accs = self.verify_accumulators(vk, messages_list, params)
+        sig_ops = params.ctx.sig
+        rows = [
+            [(s.sigma_1, acc), (sig_ops.neg(s.sigma_2), params.g_tilde)]
+            for s, acc in zip(sigs, accs)
+        ]
+        bits = self.batch_verify_pairs(rows, params)
+        return [
+            bool(b) and s.sigma_1 is not None for b, s in zip(bits, sigs)
+        ]
+
+
+class PythonBackend(CurveBackend):
+    """Reference backend: the spec ops run per-element. Slow, canonical."""
+
+    name = "python"
+
+    def msm_g1_shared(self, bases, scalars_batch):
+        return [_curve.g1.msm(bases, row) for row in scalars_batch]
+
+    def msm_g2_shared(self, bases, scalars_batch):
+        return [_curve.g2.msm(bases, row) for row in scalars_batch]
+
+    def pairing_product_is_one(self, pairs_batch):
+        return [_pairing.pairing_check(row) for row in pairs_batch]
+
+
+_REGISTRY = {}
+
+
+def register_backend(name, factory):
+    _REGISTRY[name] = factory
+
+
+def get_backend(name):
+    """Instantiate a backend by name ("python", "jax")."""
+    if name == "jax":  # lazy: importing jax is heavy and optional for CPU use
+        from .tpu.backend import JaxBackend
+
+        return JaxBackend()
+    if name in _REGISTRY:
+        return _REGISTRY[name]()
+    if name == "python":
+        return PythonBackend()
+    raise ValueError("unknown backend %r" % name)
+
+
+register_backend("python", PythonBackend)

@@ -18,19 +18,33 @@ kills):
     multi_pairing([(P_i, Q_i)]) = final_exp(prod_i miller_loop(P_i, Q_i)),
     which is also exactly the TPU batch-verify structure.
 
-The Miller loop here runs on the curve over Fp12 via the untwist
-(x', y') -> (x'/w^2, y'/w^3), w^6 = xi — simple and auditable; the C++ and
-TPU backends use twist-coordinate line evaluation for speed.
+Two Miller-loop formulations are provided and cross-checked in tests:
+  - `miller_loop` — affine over Fp12 via the untwist
+    (x', y') -> (x'/w^2, y'/w^3), w^6 = xi; one Fp12 inversion per step.
+    Simple, auditable: the cross-check oracle.
+  - `miller_loop_projective` — the PRIMARY path and the exact blueprint the
+    C++/TPU backends mirror: homogeneous coordinates on the twist, denominators
+    cleared into line/point scalings that lie in Fp2·{1, w^3} ⊂ Fp4 (a proper
+    subfield of Fp12), which the final exponentiation kills. No inversions.
+Both yield identical post-final-exp GT values (tested).
 """
 
 from .fields import (
     BLS_X,
+    FP2_ONE,
     FP2_ZERO,
     FP6_ZERO,
     FP6_ONE,
     FP12_ONE,
     P,
     R,
+    fp2_add,
+    fp2_mul,
+    fp2_mul_fp,
+    fp2_mul_xi,
+    fp2_neg,
+    fp2_sq,
+    fp2_sub,
     fp12_conj,
     fp12_frobenius,
     fp12_frobenius2,
@@ -111,6 +125,93 @@ def miller_loop(p1, q2):
     return fp12_conj(f)
 
 
+# --- Projective Miller loop (primary path; backend blueprint) ---------------
+#
+# T = (X, Y, Z) homogeneous on the twist E'(Fp2): affine (X/Z, Y/Z); untwisted
+# coordinates x_t = X/(Z w^2), y_t = Y/(Z w^3). Lines are the affine chord/
+# tangent lines scaled by a factor in Fp2·{1, w^3} ⊂ Fp4, returned as sparse
+# coefficients (lA, lB, lC) meaning  lA + lB·x_p·w^2 + lC·y_p·w^3  once
+# evaluated at P = (x_p, y_p) ∈ G1. Derivations verified against the affine
+# oracle in tests/test_ops.py.
+
+
+def proj_double_step(T):
+    """(2T, tangent-line coefficients at T).
+
+    Line: (X^3 - 8·xi·Z^3) - 3·X^2·Z·x_p·w^2 + 2·Y·Z^2·y_p·w^3, which is the
+    affine tangent line scaled by 2·Y·Z^2·w^3 (killed by final exp)."""
+    X, Y, Z = T
+    A = fp2_sq(X)
+    B = fp2_sq(Y)
+    C = fp2_sq(Z)
+    D = fp2_mul(fp2_mul(X, B), Z)
+    F = fp2_sub(fp2_mul_fp(fp2_sq(A), 9), fp2_mul_fp(D, 8))
+    YZ = fp2_mul(Y, Z)
+    X3 = fp2_mul(fp2_mul_fp(YZ, 2), F)
+    Y3 = fp2_sub(
+        fp2_mul(fp2_mul_fp(A, 3), fp2_sub(fp2_mul_fp(D, 4), F)),
+        fp2_mul_fp(fp2_mul(fp2_sq(B), C), 8),
+    )
+    t = fp2_mul_fp(YZ, 2)
+    Z3 = fp2_mul(fp2_sq(t), t)
+    lA = fp2_sub(fp2_mul(X, A), fp2_mul_fp(fp2_mul_xi(fp2_mul(Z, C)), 8))
+    lB = fp2_neg(fp2_mul_fp(fp2_mul(A, Z), 3))
+    lC = fp2_mul_fp(fp2_mul(Y, C), 2)
+    return (X3, Y3, Z3), (lA, lB, lC)
+
+
+def proj_add_step(T, q):
+    """(T + Q, chord-line coefficients), Q = (x2, y2) affine on the twist.
+
+    Line: (theta·x2 - lambda·y2) - theta·x_p·w^2 + lambda·y_p·w^3 with
+    theta = Y - y2·Z, lambda = X - x2·Z — the affine chord line scaled by
+    lambda·w^3. Degenerate for T == ±Q (unreachable for order-r Q within
+    the |BLS_X|-bit loop)."""
+    X, Y, Z = T
+    x2, y2 = q
+    theta = fp2_sub(Y, fp2_mul(y2, Z))
+    lam = fp2_sub(X, fp2_mul(x2, Z))
+    lam2 = fp2_sq(lam)
+    lam3 = fp2_mul(lam2, lam)
+    H = fp2_sub(
+        fp2_mul(fp2_sq(theta), Z), fp2_mul(lam2, fp2_add(X, fp2_mul(x2, Z)))
+    )
+    X3 = fp2_mul(lam, H)
+    Y3 = fp2_sub(fp2_mul(theta, fp2_sub(fp2_mul(lam2, X), H)), fp2_mul(lam3, Y))
+    Z3 = fp2_mul(lam3, Z)
+    lA = fp2_sub(fp2_mul(theta, x2), fp2_mul(lam, y2))
+    lB = fp2_neg(theta)
+    lC = lam
+    return (X3, Y3, Z3), (lA, lB, lC)
+
+
+def line_to_fp12(line, p1):
+    """Evaluate sparse line coefficients at P and embed into Fp12:
+    positions (w^0, w^2, w^3) -> Fp6 slots ((0,0), (0,1), (1,1))."""
+    lA, lB, lC = line
+    xp, yp = p1
+    return (
+        (lA, fp2_mul_fp(lB, xp), FP2_ZERO),
+        (FP2_ZERO, fp2_mul_fp(lC, yp), FP2_ZERO),
+    )
+
+
+def miller_loop_projective(p1, q2):
+    """Inversion-free Miller loop; same post-final-exp value as
+    `miller_loop` (line scalings lie in the Fp4 subfield)."""
+    if p1 is None or q2 is None:
+        return FP12_ONE
+    T = (q2[0], q2[1], FP2_ONE)
+    f = FP12_ONE
+    for bit in _X_ABS_BITS[1:]:
+        T, line = proj_double_step(T)
+        f = fp12_mul(fp12_sq(f), line_to_fp12(line, p1))
+        if bit == "1":
+            T, line = proj_add_step(T, q2)
+            f = fp12_mul(f, line_to_fp12(line, p1))
+    return fp12_conj(f)
+
+
 # --- Final exponentiation --------------------------------------------------
 
 # Hard-part lambda decomposition (verified exact at import):
@@ -156,12 +257,40 @@ def final_exp_slow(f):
     return fp12_pow(f, 3 * ((P**12 - 1) // R))
 
 
+# The hard part also factors as an x-power chain (the form the TPU backend
+# uses — five exponentiations by the 64-bit |BLS_X| instead of four
+# multi-hundred-bit exponents):  3·(p^4 - p^2 + 1)/r =
+# (x-1)^2·(x+p)·(x^2 + p^2 - 1) + 3.  Verified exact here:
+assert (BLS_X - 1) ** 2 * (BLS_X + P) * (BLS_X**2 + P**2 - 1) + 3 == 3 * (
+    (P**4 - P**2 + 1) // R
+)
+
+
+def final_exp_chain(f):
+    """final_exp via the x-power chain — structural blueprint for the TPU
+    backend's final exponentiation; identical output to `final_exp`."""
+    m = fp12_mul(fp12_conj(f), fp12_inv(f))
+    m = fp12_mul(fp12_frobenius2(m), m)  # cyclotomic now
+    # t0 = m^(x-1); t1 = t0^(x-1) = m^((x-1)^2)
+    t0 = fp12_mul(_cyc_pow(m, BLS_X), fp12_conj(m))
+    t1 = fp12_mul(_cyc_pow(t0, BLS_X), fp12_conj(t0))
+    # t2 = t1^(x+p) = t1^x · pi(t1)
+    t2 = fp12_mul(_cyc_pow(t1, BLS_X), fp12_frobenius(t1))
+    # t3 = t2^(x^2 + p^2 - 1) = (t2^x)^x · pi^2(t2) · conj(t2)
+    t3 = fp12_mul(
+        fp12_mul(_cyc_pow(_cyc_pow(t2, BLS_X), BLS_X), fp12_frobenius2(t2)),
+        fp12_conj(t2),
+    )
+    # · m^3
+    return fp12_mul(t3, fp12_mul(fp12_sq(m), m))
+
+
 # --- Pairing API -----------------------------------------------------------
 
 
 def pairing(p1, q2):
     """e(P, Q) for P in G1, Q in G2."""
-    return final_exp(miller_loop(p1, q2))
+    return final_exp(miller_loop_projective(p1, q2))
 
 
 def multi_pairing(pairs):
@@ -172,7 +301,7 @@ def multi_pairing(pairs):
     """
     f = FP12_ONE
     for p1, q2 in pairs:
-        f = fp12_mul(f, miller_loop(p1, q2))
+        f = fp12_mul(f, miller_loop_projective(p1, q2))
     return final_exp(f)
 
 

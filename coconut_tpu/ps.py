@@ -48,14 +48,19 @@ def batch_verify(sigs, messages_list, vk, params, backend=None):
     """Per-credential verification booleans for a batch under one verkey.
 
     `backend=None` runs the sequential reference path; a `CurveBackend`
-    (e.g. the JAX/TPU backend) executes the same math batched. This is the
-    north-star entry point (BASELINE.json configs 2 and 5)."""
+    instance or name ("python", "jax") executes the same math through the
+    batched seam (coconut_tpu/backend.py). This is the north-star entry
+    point (BASELINE.json configs 2 and 5)."""
     if len(sigs) != len(messages_list):
         raise PSError(
             "batch size mismatch: %d sigs, %d message vectors"
             % (len(sigs), len(messages_list))
         )
     if backend is not None:
+        if isinstance(backend, str):
+            from .backend import get_backend
+
+            backend = get_backend(backend)
         return backend.batch_verify(sigs, messages_list, vk, params)
     return [
         ps_verify(s, m, vk, params) for s, m in zip(sigs, messages_list)

@@ -1,0 +1,222 @@
+"""JaxBackend — the JAX/TPU CurveBackend implementation.
+
+Routes the protocol hot paths (reference signature.rs:472-478 pairing check,
+signature.rs:465/513 MSMs) through fused, jitted, batched limb kernels:
+
+  host (python ints)
+    -> limb encode (Montgomery)                      [limbs.py]
+    -> one XLA program per batch shape:
+         shared-base windowed MSM                    [curve.py]
+         -> affine normalize (batched inversion)
+         -> multi-Miller loop (scan over BLS bits)   [pairing.py]
+         -> shared final exponentiation
+         -> GT == 1 bits
+    -> decode / bools
+
+Results are bit-identical to the Python spec ops (enforced by
+tests/test_backends.py and tests/test_tpu_backend.py): identical affine
+coordinates for MSMs, identical booleans for pairing products, the spec's
+`None`-identity conventions carried as validity masks.
+
+Multi-chip: `shard_verify` shards the credential batch over a mesh axis with
+`shard_map` (data parallelism — SURVEY.md §2.3) and all-gathers the bits.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..backend import CurveBackend
+from ..ops.curve import g1 as _sg1, g2 as _sg2
+from ..ops.fields import R
+from . import curve as cv
+from . import pairing as pr
+from . import tower as tw
+from .limbs import fr_to_digits
+
+_WINDOW = 4
+_NDIG = 64
+
+
+def _build_tables(spec_ops, bases):
+    """Host-side: per-base Jacobian multiples 0..15 as spec coordinate
+    tuples (identity = the spec's (1, 1, 0))."""
+    tables = []
+    for b in bases:
+        row = [None] + [spec_ops.mul(b, d) for d in range(1, 16)]
+        enc = []
+        for p in row:
+            if p is None:
+                enc.append((spec_ops.one, spec_ops.one, spec_ops.zero))
+            else:
+                enc.append((p[0], p[1], spec_ops.one))
+        tables.append(enc)
+    # encode: [k][16] of (X, Y, Z) -> pytree with leading [k, 16]
+    flat = [e for row in tables for e in row]
+    tree = tw.encode_batch(flat)
+    k = len(bases)
+    return jax.tree_util.tree_map(
+        lambda t: t.reshape((k, 16) + t.shape[1:]), tree
+    )
+
+
+def _digits(scalars_batch):
+    return jnp.asarray(
+        np.stack(
+            [
+                np.stack([fr_to_digits(s, _WINDOW) for s in row])
+                for row in scalars_batch
+            ]
+        )
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _msm_affine_kernel(field_is_fp2, tables, digits):
+    fl = cv.FP2 if field_is_fp2 else cv.FP
+    acc = cv.msm_shared(fl, tables, digits)
+    return cv.to_affine(fl, acc)
+
+
+@jax.jit
+def _pairing_kernel(px, py, qx, qy, valid):
+    return pr.pairing_product_is_one(px, py, qx, qy, valid)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _fused_verify_kernel(sig_is_g1, tables, digits, s1, s2n, gtx, gty, inf1, inf2):
+    """Fused batch verify: MSM accumulator + 2-pair pairing product.
+
+    sig_is_g1: signatures live in G1 (ctx "G1") — accumulator is in G2;
+    otherwise roles flip. s1/s2n: sigma_1 and -sigma_2 coordinate pytrees
+    [B]; gtx/gty: g_tilde affine coordinates pre-encoded as limb pytrees;
+    inf1/inf2: identity masks for sigma_1 / sigma_2."""
+    acc_fl = cv.FP2 if sig_is_g1 else cv.FP
+    acc = cv.msm_shared(acc_fl, tables, digits)
+    ax, ay, ainf = cv.to_affine(acc_fl, acc)
+
+    def stack2(a, b):
+        return jax.tree_util.tree_map(
+            lambda x, y: jnp.stack(
+                jnp.broadcast_arrays(x, y), axis=max(x.ndim, y.ndim) - 1
+            ),
+            a,
+            b,
+        )
+
+    if sig_is_g1:
+        px = stack2(s1[0], s2n[0])
+        py = stack2(s1[1], s2n[1])
+        qx = stack2(ax, gtx)
+        qy = stack2(ay, gty)
+        pinf = jnp.stack([inf1, inf2], axis=-1)
+        qinf = jnp.stack([ainf, jnp.zeros_like(ainf)], axis=-1)
+    else:
+        px = stack2(ax, gtx)
+        py = stack2(ay, gty)
+        qx = stack2(s1[0], s2n[0])
+        qy = stack2(s1[1], s2n[1])
+        qinf = jnp.stack([inf1, inf2], axis=-1)
+        pinf = jnp.stack([ainf, jnp.zeros_like(ainf)], axis=-1)
+    valid = ~(pinf | qinf)
+    one = pr.pairing_product_is_one(px, py, qx, qy, valid)
+    return one & ~inf1
+
+
+class JaxBackend(CurveBackend):
+    """Batched JAX/TPU backend (SURVEY.md §7 stage 6)."""
+
+    name = "jax"
+
+    # -- encoding helpers ----------------------------------------------------
+
+    @staticmethod
+    def _encode_g1_points(points):
+        xs = [(0 if p is None else p[0]) for p in points]
+        ys = [(0 if p is None else p[1]) for p in points]
+        inf = jnp.asarray(np.array([p is None for p in points]))
+        return (tw.encode_batch(xs), tw.encode_batch(ys)), inf
+
+    @staticmethod
+    def _encode_g2_points(points):
+        zero2 = (0, 0)
+        xs = [(zero2 if p is None else p[0]) for p in points]
+        ys = [(zero2 if p is None else p[1]) for p in points]
+        inf = jnp.asarray(np.array([p is None for p in points]))
+        return (tw.encode_batch(xs), tw.encode_batch(ys)), inf
+
+    # -- CurveBackend primitives --------------------------------------------
+
+    def _msm_shared(self, spec_ops, is_fp2, bases, scalars_batch):
+        tables = _build_tables(spec_ops, bases)
+        digits = _digits(scalars_batch)
+        x, y, inf = _msm_affine_kernel(is_fp2, tables, digits)
+        xs = tw.decode_batch(x)
+        ys = tw.decode_batch(y)
+        infs = np.asarray(inf)
+        return [
+            None if i else (xv, yv) for xv, yv, i in zip(xs, ys, infs)
+        ]
+
+    def msm_g1_shared(self, bases, scalars_batch):
+        return self._msm_shared(_sg1, False, bases, scalars_batch)
+
+    def msm_g2_shared(self, bases, scalars_batch):
+        return self._msm_shared(_sg2, True, bases, scalars_batch)
+
+    def pairing_product_is_one(self, pairs_batch):
+        B = len(pairs_batch)
+        n = len(pairs_batch[0])
+        if any(len(row) != n for row in pairs_batch):
+            raise ValueError("ragged pairing batch")
+        flat_p = [p for row in pairs_batch for p, _ in row]
+        flat_q = [q for row in pairs_batch for _, q in row]
+        (px, py), pinf = self._encode_g1_points(flat_p)
+        (qx, qy), qinf = self._encode_g2_points(flat_q)
+        reshape = lambda t: t.reshape((B, n) + t.shape[1:])
+        px, py = jax.tree_util.tree_map(reshape, (px, py))
+        qx, qy = jax.tree_util.tree_map(reshape, (qx, qy))
+        valid = ~(pinf | qinf).reshape(B, n)
+        bits = _pairing_kernel(px, py, qx, qy, valid)
+        return [bool(b) for b in np.asarray(bits)]
+
+    # -- fused hot path ------------------------------------------------------
+
+    def batch_verify(self, sigs, messages_list, vk, params):
+        """Fully-fused batched PS verification (the north-star path)."""
+        ctx = params.ctx
+        bases = [vk.X_tilde] + list(vk.Y_tilde)
+        scalars = [[1] + [m % R for m in msgs] for msgs in messages_list]
+        tables = _build_tables(ctx.other, bases)
+        digits = _digits(scalars)
+
+        sig_pts_1 = [s.sigma_1 for s in sigs]
+        sig_pts_2n = [
+            None if s.sigma_2 is None else ctx.sig.neg(s.sigma_2) for s in sigs
+        ]
+        if ctx.name == "G1":
+            s1, inf1 = self._encode_g1_points(sig_pts_1)
+            s2n, inf2 = self._encode_g1_points(sig_pts_2n)
+            gtx = tw.fp2_encode_const(params.g_tilde[0])
+            gty = tw.fp2_encode_const(params.g_tilde[1])
+        else:
+            s1, inf1 = self._encode_g2_points(sig_pts_1)
+            s2n, inf2 = self._encode_g2_points(sig_pts_2n)
+            from .limbs import fp_encode
+
+            gtx = jnp.asarray(fp_encode(params.g_tilde[0]))
+            gty = jnp.asarray(fp_encode(params.g_tilde[1]))
+        bits = _fused_verify_kernel(
+            ctx.name == "G1",
+            tables,
+            digits,
+            s1,
+            s2n,
+            gtx,
+            gty,
+            inf1,
+            inf2,
+        )
+        return [bool(b) for b in np.asarray(bits)]

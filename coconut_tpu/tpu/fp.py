@@ -1,0 +1,226 @@
+"""Batched Fp (BLS12-381 base field) arithmetic on 16-bit limbs in uint64.
+
+Every function operates on arrays of shape [..., NLIMBS] (leading dims =
+batch) in the Montgomery domain (R = 2^384) and returns canonical
+representatives (< p, 16-bit limbs).
+
+XLA-friendly formulation (SURVEY.md §7 hard part (a), revised after
+profiling: per-limb update-slice chains made compile time explode):
+
+  - schoolbook products: one outer product + one static 0/1 matrix
+    contraction (einsum) — no sequential limb loop;
+  - Montgomery reduction in full width: m = (t * N') mod 2^384 via a
+    truncated schoolbook, then (t + m*p) / 2^384 — no word-by-word REDC;
+  - carry/borrow propagation: carry-lookahead via lax.associative_scan
+    (the (generate, propagate) monoid), log-depth and exact — no ripple.
+
+Magnitude discipline (uint64 headroom): 16x16-bit products accumulated over
+<= 24 terms stay < 2^37; the one redundant-times-16-bit product in the
+reduction stays < 2^58. All bounds are commented at the use sites.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.fields import P
+from .limbs import LIMB_BITS, MASK, MONT_R, NLIMBS, ONE_M, P_LIMBS, int_to_limbs
+
+_P_J = jnp.asarray(P_LIMBS, dtype=jnp.uint64)
+_ONE_M_J = jnp.asarray(ONE_M, dtype=jnp.uint64)
+# N' = -p^{-1} mod 2^384, full width (for the one-shot Montgomery m).
+_NPRIME_J = jnp.asarray(
+    int_to_limbs((-pow(P, -1, MONT_R)) % MONT_R), dtype=jnp.uint64
+)
+_MASK = jnp.uint64(MASK)
+_SHIFT = jnp.uint64(LIMB_BITS)
+
+def _school(a, b, out_len):
+    """Polynomial limb product c_k = sum_i a_i * b_{k-i}, truncated to
+    out_len limbs, via statically shifted copies of b and one reduction —
+    no integer dot_general (unsupported for u64 by the TPU X64 rewriter).
+    a, b: [..., N] with limb magnitudes small enough that 24 accumulated
+    pairwise products fit uint64 (callers document bounds)."""
+    rows = []
+    for i in range(NLIMBS):
+        left = min(i, out_len)
+        right = max(out_len - NLIMBS - left, 0)
+        keep = out_len - left - right
+        row = b[..., :keep]
+        pad = [(0, 0)] * (b.ndim - 1) + [(left, right)]
+        rows.append(jnp.pad(row, pad))
+    stacked = jnp.stack(rows, axis=-2)  # [..., N, out_len]
+    return jnp.sum(a[..., :, None] * stacked, axis=-2)
+
+
+# --- carry machinery --------------------------------------------------------
+
+
+def _gp_combine(lo, hi):
+    """The carry-lookahead monoid on (generate, propagate) bit pairs."""
+    g1, p1 = lo
+    g2, p2 = hi
+    return (g2 | (p2 & g1), p1 & p2)
+
+
+def _carry_fix(s):
+    """Exact carry propagation for limbs in [0, 2^16] (at most 1-bit carry):
+    returns 16-bit limbs; the final carry-out is dropped (callers guarantee
+    the value fits the buffer)."""
+    g = (s >> _SHIFT) != 0
+    p = (s & _MASK) == _MASK
+    G, _ = lax.associative_scan(_gp_combine, (g, p), axis=-1)
+    carry_in = jnp.concatenate(
+        [jnp.zeros_like(G[..., :1]), G[..., :-1]], axis=-1
+    )
+    return (s + carry_in) & _MASK
+
+
+def _norm_exact(t, buf):
+    """Redundant limbs (< 2^58) -> exact 16-bit limbs in a `buf`-limb buffer.
+    The represented value must be < 2^(16*buf)."""
+    pad = buf - t.shape[-1]
+    if pad > 0:
+        t = jnp.concatenate(
+            [t, jnp.zeros(t.shape[:-1] + (pad,), dtype=jnp.uint64)], axis=-1
+        )
+    # three halving passes: 2^58 -> 2^42+ -> 2^26+ -> <= 2^16
+    for _ in range(3):
+        lo = t & _MASK
+        hi = t >> _SHIFT
+        t = lo + jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+        )
+    return _carry_fix(t)
+
+
+def _borrow_scan(a, b):
+    """Borrow-lookahead for a - b per 16-bit limb vectors: returns
+    (difference limbs mod 2^16, full-width borrow bool)."""
+    bg = a < b
+    bp = a == b
+    BG, _ = lax.associative_scan(_gp_combine, (bg, bp), axis=-1)
+    borrow_in = jnp.concatenate(
+        [jnp.zeros_like(BG[..., :1]), BG[..., :-1]], axis=-1
+    )
+    d = (a - b - borrow_in.astype(jnp.uint64)) & _MASK
+    return d, BG[..., -1]
+
+
+def _cond_sub_p(r):
+    """r (16-bit limbs, value < 2p) -> r mod p, canonical."""
+    d, borrow = _borrow_scan(r, _P_J)
+    return jnp.where(borrow[..., None], r, d)
+
+
+# --- public ops -------------------------------------------------------------
+
+
+def zeros_like(a):
+    return jnp.zeros_like(a)
+
+
+def ones_mont(shape=()):
+    return jnp.broadcast_to(_ONE_M_J, tuple(shape) + (NLIMBS,))
+
+
+def add(a, b):
+    s = a + b  # <= 2^17 - 2 per limb
+    lo = s & _MASK
+    hi = s >> _SHIFT
+    s = lo + jnp.concatenate(
+        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+    )  # <= 2^16: 1-bit carries now
+    return _cond_sub_p(_carry_fix(s))
+
+
+def sub(a, b):
+    d, borrow = _borrow_scan(a, b)
+    # underflow lanes: add p back (value wraps mod 2^384; carry-out drops)
+    s = d + _P_J
+    lo = s & _MASK
+    hi = s >> _SHIFT
+    s = lo + jnp.concatenate(
+        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
+    )
+    dp = _carry_fix(s)
+    return jnp.where(borrow[..., None], dp, d)
+
+
+def neg(a):
+    return sub(zeros_like(a), a)
+
+
+def mul(a, b):
+    """Montgomery product a * b * 2^-384 mod p, canonical output.
+
+    Inputs: canonical 16-bit limbs (< p)."""
+    t = _school(a, b, 2 * NLIMBS - 1)  # 47 limbs < 24*2^32 = 2^36.6
+    # m = t * N' mod 2^384: truncated product of redundant t_lo by 16-bit N'
+    # -> limbs < 24 * 2^36.6 * 2^16 = 2^57.2; normalize to a true value
+    # < 2^384 before multiplying by p (REDC requires m < R).
+    m_red = _school(t[..., :NLIMBS], _NPRIME_J, NLIMBS)
+    m = _norm_exact(m_red, buf=NLIMBS + 4)[..., :NLIMBS]  # mod 2^384, 16-bit
+    u = _school(m, _P_J, 2 * NLIMBS - 1)  # 47 limbs < 2^36.6
+    # t + m*p: divisible by 2^384; high half plus the low half's carry-out.
+    w = t + u  # limbs < 2^37.6
+    lo_norm = _norm_exact(w[..., :NLIMBS], buf=NLIMBS + 3)
+    # limbs [0:24] of lo_norm are zero (REDC exactness); [24:27] are the
+    # carry into the high half.
+    hi = w[..., NLIMBS:]  # 23 limbs < 2^37.6
+    hi = jnp.concatenate(
+        [hi, jnp.zeros(hi.shape[:-1] + (1,), dtype=jnp.uint64)], axis=-1
+    )
+    hi = hi.at[..., :3].add(lo_norm[..., NLIMBS : NLIMBS + 3])
+    r = _norm_exact(hi, buf=NLIMBS)  # value < 2p < 2^382: fits 24 limbs
+    return _cond_sub_p(r)
+
+
+def sq(a):
+    return mul(a, a)
+
+
+def mul_small(a, k):
+    """a * k for tiny static k (2..12) via an addition chain."""
+    if k == 0:
+        return zeros_like(a)
+    if k == 1:
+        return a
+    half = mul_small(a, k // 2)
+    dbl = add(half, half)
+    return add(dbl, a) if k & 1 else dbl
+
+
+def pow_static(a, e):
+    """a^e for a static positive int exponent, as a scan over its bits."""
+    assert e > 0
+    bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=jnp.uint64)
+
+    def body(acc, bit):
+        acc = mul(acc, acc)
+        with_mul = mul(acc, a)
+        acc = jnp.where(bit == 1, with_mul, acc)
+        return acc, None
+
+    init = ones_mont(a.shape[:-1])
+    acc, _ = lax.scan(body, init, bits)
+    return acc
+
+
+def inv(a):
+    """a^{p-2}; returns 0 for input 0 (callers mask identities explicitly)."""
+    return pow_static(a, P - 2)
+
+
+def is_zero(a):
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a, b):
+    return jnp.all(a == b, axis=-1)
+
+
+def select(mask, a, b):
+    """mask [...] bool -> a where true else b (limb arrays)."""
+    return jnp.where(mask[..., None], a, b)

@@ -1,0 +1,109 @@
+"""Limb codec: python ints <-> 24 x 16-bit limbs in uint64 lanes.
+
+The limb decomposition is the host<->device wire format for all field
+elements (SURVEY.md §7 stage 6 "limb codec"). 16-bit limbs were chosen so
+that schoolbook products (16x16 -> 32 bits) accumulated over 24 terms plus
+Montgomery-reduction additions stay below 2^38 — comfortably inside a uint64
+accumulator with no carry splitting inside the inner loops (the hard part (a)
+in SURVEY.md §7: TPU-width-friendly carry discipline).
+
+Least-significant limb first. Fp values travel in the Montgomery domain
+(a * 2^384 mod p) between kernels; encode/decode converts at the boundary so
+results are bit-identical to the pure-Python spec (`coconut_tpu.ops.fields`).
+"""
+
+import numpy as np
+
+from ..ops.fields import P, R
+
+LIMB_BITS = 16
+NLIMBS = 24  # 24 * 16 = 384 bits >= 381
+MASK = (1 << LIMB_BITS) - 1
+MONT_BITS = LIMB_BITS * NLIMBS  # 384
+MONT_R = 1 << MONT_BITS
+
+# Fr scalars: 16 limbs of 16 bits = 256 bits >= 255
+FR_NLIMBS = 16
+
+
+def int_to_limbs(x, nlimbs=NLIMBS):
+    """Python int -> np.uint64[nlimbs], least-significant first."""
+    if not 0 <= x < (1 << (LIMB_BITS * nlimbs)):
+        raise ValueError("value out of range for %d limbs" % nlimbs)
+    return np.array(
+        [(x >> (LIMB_BITS * i)) & MASK for i in range(nlimbs)], dtype=np.uint64
+    )
+
+
+def limbs_to_int(limbs):
+    """np/jnp uint array (last axis = limbs) -> python int (single element)."""
+    arr = np.asarray(limbs, dtype=np.uint64)
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr))
+
+
+def ints_to_limbs(xs, nlimbs=NLIMBS):
+    """[...] nested list of ints -> np.uint64[..., nlimbs]."""
+    a = np.asarray(
+        [[int(x) >> (LIMB_BITS * i) & MASK for i in range(nlimbs)] for x in xs],
+        dtype=np.uint64,
+    )
+    return a
+
+
+def limbs_to_ints(arr):
+    """np.uint64[..., nlimbs] -> nested list of ints over the last axis."""
+    a = np.asarray(arr, dtype=np.uint64)
+    flat = a.reshape(-1, a.shape[-1])
+    out = [
+        sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(row)) for row in flat
+    ]
+    return np.array(out, dtype=object).reshape(a.shape[:-1]).tolist() if a.ndim > 1 else out[0]
+
+
+# --- Montgomery constants ---------------------------------------------------
+
+P_LIMBS = int_to_limbs(P)
+# -p^{-1} mod 2^16 (the REDC multiplier derivation constant)
+N0 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+# R^2 mod p: multiply by this (Montgomery-mul) to enter the domain
+R2 = int_to_limbs(MONT_R * MONT_R % P)
+# Montgomery representation of 1 and 0
+ONE_M = int_to_limbs(MONT_R % P)
+ZERO = int_to_limbs(0)
+
+
+def fp_encode(x):
+    """Canonical Fp int -> Montgomery limb vector (numpy; host-side)."""
+    return int_to_limbs(x % P * MONT_R % P)
+
+
+def fp_decode(limbs):
+    """Montgomery limb vector -> canonical Fp int (host-side)."""
+    return limbs_to_int(limbs) * pow(MONT_R, -1, P) % P
+
+
+def fp_encode_batch(xs):
+    """list/array of ints [...] -> np.uint64[..., NLIMBS] in Montgomery form."""
+    return ints_to_limbs([int(x) % P * MONT_R % P for x in xs])
+
+
+def fp_decode_batch(arr):
+    """np.uint64[..., NLIMBS] Montgomery -> list of canonical ints."""
+    rinv = pow(MONT_R, -1, P)
+    a = np.asarray(arr, dtype=np.uint64)
+    flat = a.reshape(-1, a.shape[-1])
+    return [
+        sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(row)) * rinv % P
+        for row in flat
+    ]
+
+
+def fr_to_digits(k, window=4):
+    """Fr scalar -> fixed-length window-digit vector (np.uint32), most
+    significant digit first — the MSM window schedule."""
+    k = int(k) % R
+    ndig = (256 + window - 1) // window
+    return np.array(
+        [(k >> (window * i)) & ((1 << window) - 1) for i in range(ndig - 1, -1, -1)],
+        dtype=np.uint32,
+    )

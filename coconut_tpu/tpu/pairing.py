@@ -1,0 +1,165 @@
+"""Batched optimal-ate pairing: multi-Miller loop + final exponentiation.
+
+Blueprint: `ops.pairing.miller_loop_projective` / `final_exp_chain` — the
+same homogeneous twist coordinates, line coefficients, and x-power chain, so
+post-final-exp GT values decode bit-identical to the spec (the line scalings
+lie in the Fp4 subfield and are killed by the final exponentiation; spec
+pairing.py docstring).
+
+Shapes: a "pair set" has G1 points [..., ] and twist points as Fp2 pytrees
+with the same leading dims; the Miller scan runs over the static |BLS_X| bit
+schedule (lax.scan, select for the 6 sparse addition steps). Identity inputs
+are handled with validity masks exactly like the spec's `None` convention
+(miller factor = 1).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..ops.fields import BLS_X
+from . import fp
+from . import tower as tw
+
+# Static bit schedule of |BLS_X|, msb first, leading bit dropped.
+_XBITS = jnp.array([int(b) for b in bin(-BLS_X)[2:]][1:], dtype=jnp.uint64)
+
+
+def _proj_double_step(T):
+    """Mirror of ops.pairing.proj_double_step on Fp2 limb pytrees."""
+    X, Y, Z = T
+    A = tw.fp2_sq(X)
+    B = tw.fp2_sq(Y)
+    C = tw.fp2_sq(Z)
+    D = tw.fp2_mul(tw.fp2_mul(X, B), Z)
+    F = tw.fp2_sub(tw.fp2_mul_small(tw.fp2_sq(A), 9), tw.fp2_mul_small(D, 8))
+    YZ = tw.fp2_mul(Y, Z)
+    X3 = tw.fp2_mul(tw.fp2_mul_small(YZ, 2), F)
+    Y3 = tw.fp2_sub(
+        tw.fp2_mul(tw.fp2_mul_small(A, 3), tw.fp2_sub(tw.fp2_mul_small(D, 4), F)),
+        tw.fp2_mul_small(tw.fp2_mul(tw.fp2_sq(B), C), 8),
+    )
+    t = tw.fp2_mul_small(YZ, 2)
+    Z3 = tw.fp2_mul(tw.fp2_sq(t), t)
+    lA = tw.fp2_sub(
+        tw.fp2_mul(X, A), tw.fp2_mul_small(tw.fp2_mul_xi(tw.fp2_mul(Z, C)), 8)
+    )
+    lB = tw.fp2_neg(tw.fp2_mul_small(tw.fp2_mul(A, Z), 3))
+    lC = tw.fp2_mul_small(tw.fp2_mul(Y, C), 2)
+    return (X3, Y3, Z3), (lA, lB, lC)
+
+
+def _proj_add_step(T, q):
+    """Mirror of ops.pairing.proj_add_step; q = (x2, y2) affine twist."""
+    X, Y, Z = T
+    x2, y2 = q
+    theta = tw.fp2_sub(Y, tw.fp2_mul(y2, Z))
+    lam = tw.fp2_sub(X, tw.fp2_mul(x2, Z))
+    lam2 = tw.fp2_sq(lam)
+    lam3 = tw.fp2_mul(lam2, lam)
+    H = tw.fp2_sub(
+        tw.fp2_mul(tw.fp2_sq(theta), Z),
+        tw.fp2_mul(lam2, tw.fp2_add(X, tw.fp2_mul(x2, Z))),
+    )
+    X3 = tw.fp2_mul(lam, H)
+    Y3 = tw.fp2_sub(
+        tw.fp2_mul(theta, tw.fp2_sub(tw.fp2_mul(lam2, X), H)),
+        tw.fp2_mul(lam3, Y),
+    )
+    Z3 = tw.fp2_mul(lam3, Z)
+    lA = tw.fp2_sub(tw.fp2_mul(theta, x2), tw.fp2_mul(lam, y2))
+    lB = tw.fp2_neg(theta)
+    lC = lam
+    return (X3, Y3, Z3), (lA, lB, lC)
+
+
+def _eval_line(line, px, py):
+    """(lA, lB, lC) -> (lA, lB*px, lC*py): the sparse element for mul_line."""
+    lA, lB, lC = line
+    return (lA, tw.fp2_mul_fp(lB, px), tw.fp2_mul_fp(lC, py))
+
+
+def multi_miller_loop(px, py, qx, qy, valid):
+    """Product of Miller loops over the trailing "pairs" axis folded into the
+    leading batch dims.
+
+    px, py: Fp limb arrays [...]; qx, qy: Fp2 pytrees (affine twist);
+    valid: bool [...] — False lanes contribute the factor 1 (the spec's
+    `None` -> FP12_ONE convention).
+    Returns an Fp12 pytree with the same leading dims [...]."""
+    shape = valid.shape
+    T0 = (qx, qy, tw.fp2_ones(shape))
+    f0 = tw.fp12_ones(shape)
+
+    def body(carry, bit):
+        f, T = carry
+        T, line = _proj_double_step(T)
+        f = tw.mul_line(tw.fp12_sq(f), _eval_line(line, px, py))
+        Ta, la = _proj_add_step(T, (qx, qy))
+        fa = tw.mul_line(f, _eval_line(la, px, py))
+        use = bit == 1
+        f = tw.fp12_select(jnp.broadcast_to(use, shape), fa, f)
+        T = tuple(
+            tw.fp2_select(jnp.broadcast_to(use, shape), a, b)
+            for a, b in zip(Ta, T)
+        )
+        return (f, T), None
+
+    (f, _), _ = lax.scan(body, (f0, T0), _XBITS)
+    f = tw.fp12_conj(f)  # x < 0
+    f = tw.fp12_select(valid, f, tw.fp12_ones(shape))
+    # fold the pairs axis (last leading dim) by multiplication
+    npairs = shape[-1]
+    out = _index_fp12(f, 0)
+    for i in range(1, npairs):
+        out = tw.fp12_mul(out, _index_fp12(f, i))
+    return out
+
+
+def _index_fp12(f, i):
+    import jax
+
+    return jax.tree_util.tree_map(lambda t: t[..., i, :], f)
+
+
+def _pow_x_abs(m):
+    """m^{|BLS_X|} in the cyclotomic subgroup (scan over the static bits)."""
+
+    def body(acc, bit):
+        acc = tw.fp12_sq(acc)
+        accm = tw.fp12_mul(acc, m)
+        acc = tw.fp12_select(
+            jnp.broadcast_to(bit == 1, _leading(acc)), accm, acc
+        )
+        return acc, None
+
+    acc, _ = lax.scan(body, m, _XBITS)  # leading bit folds in via init = m
+    return acc
+
+
+def _leading(f):
+    return f[0][0][0].shape[:-1]
+
+
+def _pow_x_neg(m):
+    """m^{BLS_X} (x negative): conj of m^{|x|}."""
+    return tw.fp12_conj(_pow_x_abs(m))
+
+
+def final_exp(f):
+    """Mirror of ops.pairing.final_exp_chain (identical GT values)."""
+    m = tw.fp12_mul(tw.fp12_conj(f), tw.fp12_inv(f))
+    m = tw.fp12_mul(tw.fp12_frobenius2(m), m)
+    t0 = tw.fp12_mul(_pow_x_neg(m), tw.fp12_conj(m))
+    t1 = tw.fp12_mul(_pow_x_neg(t0), tw.fp12_conj(t0))
+    t2 = tw.fp12_mul(_pow_x_neg(t1), tw.fp12_frobenius(t1))
+    t3 = tw.fp12_mul(
+        tw.fp12_mul(_pow_x_neg(_pow_x_neg(t2)), tw.fp12_frobenius2(t2)),
+        tw.fp12_conj(t2),
+    )
+    return tw.fp12_mul(t3, tw.fp12_mul(tw.fp12_sq(m), m))
+
+
+def pairing_product_is_one(px, py, qx, qy, valid):
+    """[..., npairs] pair sets -> bool [...]: prod e(P_i, Q_i) == 1."""
+    f = multi_miller_loop(px, py, qx, qy, valid)
+    return tw.fp12_is_one(final_exp(f))

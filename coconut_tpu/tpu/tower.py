@@ -1,0 +1,312 @@
+"""Extension-field tower Fp2/Fp6/Fp12 over the limb Fp — batched, as pytrees.
+
+Mirrors `coconut_tpu.ops.fields` exactly (same tower construction
+u^2 = -1, v^3 = xi = u+1, w^2 = v; same Karatsuba/complex formulas) so decoded
+results are bit-identical to the spec. Elements are tuples of Fp limb arrays,
+which makes every value a JAX pytree that flows through scan/jit unchanged.
+
+Additionally provides the sparse Fp12 x line multiplication for the Miller
+loop (`mul_line`): lines have only the (w^0, w^2, w^3) components (see
+`ops.pairing.line_to_fp12`), costing 15 Fp2 products instead of a full 54-mul
+Fp12 multiply.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import fields as F
+from . import fp
+from .limbs import NLIMBS, fp_encode
+
+# --- codecs (host-side) -----------------------------------------------------
+
+
+def encode_batch(elems):
+    """List of same-structure spec elements (ints / nested tuples) ->
+    pytree of Montgomery limb arrays with leading batch dim."""
+    first = elems[0]
+    if isinstance(first, tuple):
+        return tuple(
+            encode_batch([e[i] for e in elems]) for i in range(len(first))
+        )
+    from .limbs import fp_encode_batch
+
+    return jnp.asarray(fp_encode_batch(elems))
+
+
+def decode_batch(tree):
+    """Inverse of encode_batch: pytree of limb arrays -> list of spec
+    elements (canonical ints / nested tuples)."""
+    if isinstance(tree, tuple):
+        parts = [decode_batch(t) for t in tree]
+        return [tuple(p[i] for p in parts) for i in range(len(parts[0]))]
+    import numpy as np
+
+    from .limbs import fp_decode_batch
+
+    return fp_decode_batch(np.asarray(tree))
+
+
+# --- Fp2 --------------------------------------------------------------------
+
+
+def fp2_encode_const(c):
+    """Spec Fp2 (int pair) -> Montgomery limb constant pytree."""
+    return (jnp.asarray(fp_encode(c[0])), jnp.asarray(fp_encode(c[1])))
+
+
+def fp2_add(a, b):
+    return (fp.add(a[0], b[0]), fp.add(a[1], b[1]))
+
+
+def fp2_sub(a, b):
+    return (fp.sub(a[0], b[0]), fp.sub(a[1], b[1]))
+
+
+def fp2_neg(a):
+    return (fp.neg(a[0]), fp.neg(a[1]))
+
+
+def fp2_mul(a, b):
+    t0 = fp.mul(a[0], b[0])
+    t1 = fp.mul(a[1], b[1])
+    t2 = fp.mul(fp.add(a[0], a[1]), fp.add(b[0], b[1]))
+    return (fp.sub(t0, t1), fp.sub(fp.sub(t2, t0), t1))
+
+
+def fp2_sq(a):
+    # (a0+a1)(a0-a1), 2*a0*a1
+    return (
+        fp.mul(fp.add(a[0], a[1]), fp.sub(a[0], a[1])),
+        fp.mul_small(fp.mul(a[0], a[1]), 2),
+    )
+
+
+def fp2_mul_fp(a, s):
+    return (fp.mul(a[0], s), fp.mul(a[1], s))
+
+
+def fp2_mul_small(a, k):
+    return (fp.mul_small(a[0], k), fp.mul_small(a[1], k))
+
+
+def fp2_conj(a):
+    return (a[0], fp.neg(a[1]))
+
+
+def fp2_mul_xi(a):
+    """x (u+1): (c0 - c1, c0 + c1)."""
+    return (fp.sub(a[0], a[1]), fp.add(a[0], a[1]))
+
+
+def fp2_inv(a):
+    norm = fp.add(fp.sq(a[0]), fp.sq(a[1]))
+    ninv = fp.inv(norm)
+    return (fp.mul(a[0], ninv), fp.neg(fp.mul(a[1], ninv)))
+
+
+def fp2_is_zero(a):
+    return fp.is_zero(a[0]) & fp.is_zero(a[1])
+
+
+def fp2_eq(a, b):
+    return fp.eq(a[0], b[0]) & fp.eq(a[1], b[1])
+
+
+def fp2_select(mask, a, b):
+    return (fp.select(mask, a[0], b[0]), fp.select(mask, a[1], b[1]))
+
+
+def fp2_zeros(shape=()):
+    z = jnp.zeros(tuple(shape) + (NLIMBS,), dtype=jnp.uint64)
+    return (z, z)
+
+
+def fp2_ones(shape=()):
+    return (fp.ones_mont(shape), jnp.zeros(tuple(shape) + (NLIMBS,), dtype=jnp.uint64))
+
+
+# --- Fp6 --------------------------------------------------------------------
+
+
+def fp6_add(a, b):
+    return tuple(fp2_add(x, y) for x, y in zip(a, b))
+
+
+def fp6_sub(a, b):
+    return tuple(fp2_sub(x, y) for x, y in zip(a, b))
+
+
+def fp6_neg(a):
+    return tuple(fp2_neg(x) for x in a)
+
+
+def fp6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = fp2_mul(a0, b0)
+    t1 = fp2_mul(a1, b1)
+    t2 = fp2_mul(a2, b2)
+    c0 = fp2_add(
+        t0,
+        fp2_mul_xi(
+            fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)
+        ),
+    )
+    c1 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1),
+        fp2_mul_xi(t2),
+    )
+    c2 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1
+    )
+    return (c0, c1, c2)
+
+
+def fp6_mul_by_01(a, s0, s1):
+    """a * (s0 + s1 v) — sparse, 6 Fp2 products."""
+    a0, a1, a2 = a
+    return (
+        fp2_add(fp2_mul(a0, s0), fp2_mul_xi(fp2_mul(a2, s1))),
+        fp2_add(fp2_mul(a1, s0), fp2_mul(a0, s1)),
+        fp2_add(fp2_mul(a2, s0), fp2_mul(a1, s1)),
+    )
+
+
+def fp6_mul_by_1(a, s1):
+    """a * (s1 v) — sparse, 3 Fp2 products."""
+    a0, a1, a2 = a
+    return (fp2_mul_xi(fp2_mul(a2, s1)), fp2_mul(a0, s1), fp2_mul(a1, s1))
+
+
+def fp6_mul_by_v(a):
+    return (fp2_mul_xi(a[2]), a[0], a[1])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a
+    c0 = fp2_sub(fp2_sq(a0), fp2_mul_xi(fp2_mul(a1, a2)))
+    c1 = fp2_sub(fp2_mul_xi(fp2_sq(a2)), fp2_mul(a0, a1))
+    c2 = fp2_sub(fp2_sq(a1), fp2_mul(a0, a2))
+    t = fp2_add(
+        fp2_mul_xi(fp2_add(fp2_mul(a2, c1), fp2_mul(a1, c2))), fp2_mul(a0, c0)
+    )
+    tinv = fp2_inv(t)
+    return (fp2_mul(c0, tinv), fp2_mul(c1, tinv), fp2_mul(c2, tinv))
+
+
+def fp6_select(mask, a, b):
+    return tuple(fp2_select(mask, x, y) for x, y in zip(a, b))
+
+
+def fp6_zeros(shape=()):
+    z = fp2_zeros(shape)
+    return (z, z, z)
+
+
+def fp6_ones(shape=()):
+    return (fp2_ones(shape), fp2_zeros(shape), fp2_zeros(shape))
+
+
+# --- Fp12 -------------------------------------------------------------------
+
+
+def fp12_mul(a, b):
+    a0, a1 = a
+    b0, b1 = b
+    t0 = fp6_mul(a0, b0)
+    t1 = fp6_mul(a1, b1)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    return (c0, c1)
+
+
+def fp12_sq(a):
+    a0, a1 = a
+    t = fp6_mul(a0, a1)
+    c0 = fp6_sub(
+        fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1))), t),
+        fp6_mul_by_v(t),
+    )
+    c1 = fp6_add(t, t)
+    return (c0, c1)
+
+
+def fp12_conj(a):
+    return (a[0], fp6_neg(a[1]))
+
+
+def fp12_inv(a):
+    a0, a1 = a
+    t = fp6_sub(fp6_sq_(a0), fp6_mul_by_v(fp6_sq_(a1)))
+    tinv = fp6_inv(t)
+    return (fp6_mul(a0, tinv), fp6_neg(fp6_mul(a1, tinv)))
+
+
+def fp6_sq_(a):
+    return fp6_mul(a, a)
+
+
+def mul_line(f, line):
+    """f * (lA + lB w^2 + lC w^3) — the Miller-loop sparse product.
+
+    The line element is s = (s0, s1) with s0 = (lA, lB, 0), s1 = (0, lC, 0)
+    (cf. ops.pairing.line_to_fp12). 15 Fp2 products total."""
+    lA, lB, lC = line
+    f0, f1 = f
+    t0 = fp6_mul_by_01(f0, lA, lB)
+    t1 = fp6_mul_by_1(f1, lC)
+    c0 = fp6_add(t0, fp6_mul_by_v(t1))
+    # (f0 + f1) * (lA, lB + lC, 0)
+    mixed = fp6_mul_by_01(fp6_add(f0, f1), lA, fp2_add(lB, lC))
+    c1 = fp6_sub(fp6_sub(mixed, t0), t1)
+    return (c0, c1)
+
+
+# Frobenius coefficients from the spec, as Montgomery constants.
+_G1C = [fp2_encode_const(c) for c in F._GAMMA1]
+_G2C = [fp2_encode_const(c) for c in F._GAMMA2]
+
+
+def fp12_frobenius(a):
+    a0, a1 = a
+    b0 = (
+        fp2_conj(a0[0]),
+        fp2_mul(fp2_conj(a0[1]), _G1C[2]),
+        fp2_mul(fp2_conj(a0[2]), _G1C[4]),
+    )
+    b1 = (
+        fp2_mul(fp2_conj(a1[0]), _G1C[1]),
+        fp2_mul(fp2_conj(a1[1]), _G1C[3]),
+        fp2_mul(fp2_conj(a1[2]), _G1C[5]),
+    )
+    return (b0, b1)
+
+
+def fp12_frobenius2(a):
+    a0, a1 = a
+    b0 = (a0[0], fp2_mul(a0[1], _G2C[2]), fp2_mul(a0[2], _G2C[4]))
+    b1 = (
+        fp2_mul(a1[0], _G2C[1]),
+        fp2_mul(a1[1], _G2C[3]),
+        fp2_mul(a1[2], _G2C[5]),
+    )
+    return (b0, b1)
+
+
+def fp12_select(mask, a, b):
+    return tuple(fp6_select(mask, x, y) for x, y in zip(a, b))
+
+
+def fp12_ones(shape=()):
+    return (fp6_ones(shape), fp6_zeros(shape))
+
+
+def fp12_is_one(a):
+    """Componentwise equality with the Montgomery one."""
+    one = fp12_ones(a[0][0][0].shape[:-1])
+    bits = None
+    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(one)):
+        b = jnp.all(x == y, axis=-1)
+        bits = b if bits is None else (bits & b)
+    return bits

@@ -172,6 +172,28 @@ class TestPairing:
         f = rand_fp12()
         assert pr.final_exp(f) == pr.final_exp_slow(f)
 
+    def test_final_exp_chain_matches(self):
+        # The x-power-chain form (TPU backend blueprint) equals the
+        # Frobenius multi-exp form on arbitrary Fp12 inputs.
+        for _ in range(3):
+            f = rand_fp12()
+            assert pr.final_exp_chain(f) == pr.final_exp(f)
+
+    def test_projective_miller_matches_affine(self):
+        # Projective (inversion-free, backend blueprint) and affine (oracle)
+        # Miller loops agree after final exponentiation; the raw Miller
+        # values differ by the Fp4-subfield line scalings.
+        for _ in range(3):
+            a, b = rand_fr(), rand_fr()
+            p1, q2 = g1.mul(G1_GEN, a), g2.mul(G2_GEN, b)
+            assert pr.final_exp(pr.miller_loop_projective(p1, q2)) == (
+                pr.final_exp(pr.miller_loop(p1, q2))
+            )
+
+    def test_projective_miller_identity_inputs(self):
+        assert pr.miller_loop_projective(None, G2_GEN) == FP12_ONE
+        assert pr.miller_loop_projective(G1_GEN, None) == FP12_ONE
+
     def test_pairing_check_product(self):
         # e(P, bQ) * e(-bP, Q) == 1
         b = rand_fr()
