@@ -62,6 +62,8 @@ from .signature import (  # noqa
     SignatureRequestPoK,
     SignatureRequestProof,
     Verkey,
+    batch_blind_sign,
+    batch_unblind,
     fiat_shamir_challenge,
 )
 from .sss import (  # noqa

@@ -52,6 +52,19 @@ class CurveBackend:
         [B] bools: prod_j e(P_ij, Q_ij) == 1 per row."""
         raise NotImplementedError
 
+    def msm_g1_distinct(self, points_batch, scalars_batch):
+        """sum_j scalars[i][j] * points[i][j] in G1 per batch row i —
+        per-row bases (the issuance shape: each request carries its own
+        ciphertext points, reference signature.rs:400-428).
+
+        points_batch: [B][k] G1 affine points; scalars_batch: [B][k] ints.
+        Returns [B] G1 affine points."""
+        raise NotImplementedError
+
+    def msm_g2_distinct(self, points_batch, scalars_batch):
+        """Same as msm_g1_distinct, in G2."""
+        raise NotImplementedError
+
     # -- composed operations ------------------------------------------------
 
     def verify_accumulators(self, vk, messages_list, params):
@@ -103,6 +116,18 @@ class PythonBackend(CurveBackend):
     def msm_g2_shared(self, bases, scalars_batch):
         return [_curve.g2.msm(bases, row) for row in scalars_batch]
 
+    def msm_g1_distinct(self, points_batch, scalars_batch):
+        return [
+            _curve.g1.msm(pts, row)
+            for pts, row in zip(points_batch, scalars_batch)
+        ]
+
+    def msm_g2_distinct(self, points_batch, scalars_batch):
+        return [
+            _curve.g2.msm(pts, row)
+            for pts, row in zip(points_batch, scalars_batch)
+        ]
+
     def pairing_product_is_one(self, pairs_batch):
         return [_pairing.pairing_check(row) for row in pairs_batch]
 
@@ -120,6 +145,14 @@ def get_backend(name):
         from .tpu.backend import JaxBackend
 
         return JaxBackend()
+    if name == "cpp":  # lazy: builds the native library on first use
+        from .native import CppBackend
+
+        return CppBackend()
+    if name == "cpp_ct":  # const-time MSM schedule for secret scalars
+        from .native import CppBackend
+
+        return CppBackend(ct=True)
     if name in _REGISTRY:
         return _REGISTRY[name]()
     if name == "python":

@@ -1,0 +1,169 @@
+"""CppBackend — ctypes bridge to the native C++ core (native/ccbls.cpp).
+
+SURVEY.md §7 stage 1's Python-visible face: the same `CurveBackend` seam the
+JAX backend implements, routed through the batch C ABI of `libccbls.so`.
+The native library is the framework's CPU baseline (BASELINE.md) and the
+const-time-capable issuance path (reference const-time MSM call sites
+signature.rs:157,424-428; `ct=True` selects the masked-lookup schedule —
+note the remaining caveat that Jacobian addition edge cases still branch,
+which full completeness would fix; tracked as future hardening).
+
+Wire codec (must match ccbls.cpp): Fp = 48B LE canonical; affine G1 = x||y
+(96B), G2 = x.c0||x.c1||y.c0||y.c1 (192B); infinity = all-zero bytes
+(0^3+4 != 0 so the encoding is unambiguous); scalars = 32B LE canonical Fr.
+
+Build on demand: `make -C native` (g++); `CCBLS_SO` overrides the path.
+"""
+
+import ctypes
+import os
+import subprocess
+
+from .backend import CurveBackend, register_backend
+from .ops.fields import R
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+_SO_PATH = os.environ.get("CCBLS_SO", os.path.join(_NATIVE_DIR, "libccbls.so"))
+
+_lib = None
+
+
+def _build():
+    subprocess.run(
+        ["make", "-C", _NATIVE_DIR, "libccbls.so"],
+        check=True,
+        capture_output=True,
+    )
+
+
+def load(build_if_missing=True):
+    """Load (building if needed) and selftest the native library."""
+    global _lib
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO_PATH) and build_if_missing:
+        _build()
+    lib = ctypes.CDLL(_SO_PATH)
+    lib.cc_selftest.restype = ctypes.c_int
+    rc = lib.cc_selftest()
+    if rc != 0:
+        raise RuntimeError("ccbls selftest failed: %d" % rc)
+    for name, argt in [
+        ("cc_msm_g1", [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]),
+        ("cc_msm_g2", [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_int]),
+        ("cc_pairing_product_is_one", [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p]),
+        ("cc_g1_mul", [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]),
+    ]:
+        fn = getattr(lib, name)
+        fn.argtypes = argt
+        fn.restype = None
+    _lib = lib
+    return lib
+
+
+# --- codec (ints <-> the C ABI byte layout) ---------------------------------
+
+
+def _fp_bytes(x):
+    return int(x).to_bytes(48, "little")
+
+
+def _g1_bytes(p):
+    if p is None:
+        return b"\x00" * 96
+    return _fp_bytes(p[0]) + _fp_bytes(p[1])
+
+
+def _g2_bytes(p):
+    if p is None:
+        return b"\x00" * 192
+    (x0, x1), (y0, y1) = p
+    return _fp_bytes(x0) + _fp_bytes(x1) + _fp_bytes(y0) + _fp_bytes(y1)
+
+
+def _g1_parse(b):
+    if not any(b):
+        return None
+    return (
+        int.from_bytes(b[:48], "little"),
+        int.from_bytes(b[48:96], "little"),
+    )
+
+
+def _g2_parse(b):
+    if not any(b):
+        return None
+    vals = [int.from_bytes(b[i * 48 : (i + 1) * 48], "little") for i in range(4)]
+    return ((vals[0], vals[1]), (vals[2], vals[3]))
+
+
+def _scalar_bytes(s):
+    return (int(s) % R).to_bytes(32, "little")
+
+
+class CppBackend(CurveBackend):
+    """Native C++ batched backend (the CPU baseline)."""
+
+    name = "cpp"
+
+    def __init__(self, ct=False):
+        self._lib = load()
+        self._ct = 1 if ct else 0
+
+    def msm_g1_shared(self, bases, scalars_batch):
+        k = len(bases)
+        B = len(scalars_batch)
+        bb = b"".join(_g1_bytes(p) for p in bases)
+        sb = b"".join(
+            _scalar_bytes(s) for row in scalars_batch for s in row
+        )
+        out = ctypes.create_string_buffer(96 * B)
+        self._lib.cc_msm_g1(bb, sb, k, B, out, self._ct)
+        return [_g1_parse(out.raw[i * 96 : (i + 1) * 96]) for i in range(B)]
+
+    def msm_g2_shared(self, bases, scalars_batch):
+        k = len(bases)
+        B = len(scalars_batch)
+        bb = b"".join(_g2_bytes(p) for p in bases)
+        sb = b"".join(
+            _scalar_bytes(s) for row in scalars_batch for s in row
+        )
+        out = ctypes.create_string_buffer(192 * B)
+        self._lib.cc_msm_g2(bb, sb, k, B, out, self._ct)
+        return [_g2_parse(out.raw[i * 192 : (i + 1) * 192]) for i in range(B)]
+
+    def msm_g1_distinct(self, points_batch, scalars_batch):
+        # per-row bases: each row is a size-k shared-base MSM with B=1
+        return [
+            self.msm_g1_shared(pts, [row])[0]
+            for pts, row in zip(points_batch, scalars_batch)
+        ]
+
+    def msm_g2_distinct(self, points_batch, scalars_batch):
+        return [
+            self.msm_g2_shared(pts, [row])[0]
+            for pts, row in zip(points_batch, scalars_batch)
+        ]
+
+    def pairing_product_is_one(self, pairs_batch):
+        B = len(pairs_batch)
+        n = len(pairs_batch[0]) if B else 0
+        if any(len(row) != n for row in pairs_batch):
+            raise ValueError("ragged pairing batch")
+        pb = b"".join(_g1_bytes(p) for row in pairs_batch for p, _ in row)
+        qb = b"".join(_g2_bytes(q) for row in pairs_batch for _, q in row)
+        out = ctypes.create_string_buffer(B)
+        self._lib.cc_pairing_product_is_one(pb, qb, n, B, out)
+        return [bool(out.raw[i]) for i in range(B)]
+
+
+def available():
+    """True if the native backend can load (build tools + source present)."""
+    try:
+        load()
+        return True
+    except Exception:
+        return False
+
+
+register_backend("cpp", CppBackend)

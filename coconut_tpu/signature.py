@@ -520,6 +520,92 @@ class BlindSignature:
         )
 
 
+def batch_blind_sign(sig_requests, sigkey, params, backend=None):
+    """Signer-side BlindSign over a batch of requests (BASELINE config 4).
+
+    Same math as `BlindSignature.new` per request (reference
+    signature.rs:396-428: c_tilde_1 = prod a_i^{y_i},
+    c_tilde_2 = prod b_i^{y_i} * h^{x + sum y_j m_j}), but the two MSMs of
+    every request run as ONE batched distinct-base MSM each through the
+    backend — the bases (ciphertext points, h) differ per request, so this
+    uses the `msm_*_distinct` primitive, not the shared-table path.
+
+    All requests must have the same hidden/known message split. Callers must
+    have verified each request's PoK first (signature.rs:613-616).
+    Returns [B] BlindSignature.
+
+    Timing discipline: the scalars here are the signer's long-term secrets
+    (the reference runs these MSMs const-time, signature.rs:424-428). Pass
+    backend="cpp_ct" for the native masked-lookup schedule; the default
+    Python spec path and the JAX path are variable-time hosts and suitable
+    for development / throughput benchmarking, not hostile co-tenancy."""
+    from .backend import get_backend
+
+    if not sig_requests:
+        return []
+    if backend is None:
+        backend = get_backend("python")
+    elif isinstance(backend, str):
+        backend = get_backend(backend)
+    ctx = params.ctx
+    hidden_count = len(sig_requests[0].ciphertexts)
+    for req in sig_requests:
+        if len(req.ciphertexts) != hidden_count or len(
+            req.known_messages
+        ) != len(sigkey.y) - hidden_count:
+            raise UnsupportedNoOfMessages(
+                len(sigkey.y),
+                len(req.ciphertexts) + len(req.known_messages),
+            )
+    hs = [req.get_h(ctx) for req in sig_requests]
+    c1_points, c1_scalars, c2_points, c2_scalars = [], [], [], []
+    for req, h in zip(sig_requests, hs):
+        c1_points.append([a for a, _ in req.ciphertexts])
+        c1_scalars.append(list(sigkey.y[:hidden_count]))
+        exp = sigkey.x
+        for i, m in enumerate(req.known_messages):
+            exp = (exp + sigkey.y[hidden_count + i] * m) % R
+        c2_points.append([b for _, b in req.ciphertexts] + [h])
+        c2_scalars.append(list(sigkey.y[:hidden_count]) + [exp])
+    msm = (
+        backend.msm_g1_distinct
+        if ctx.name == "G1"
+        else backend.msm_g2_distinct
+    )
+    c1s = msm(c1_points, c1_scalars)
+    c2s = msm(c2_points, c2_scalars)
+    return [
+        BlindSignature(h, (c1, c2)) for h, c1, c2 in zip(hs, c1s, c2s)
+    ]
+
+
+def batch_unblind(blind_sigs, elgamal_sk, ctx, backend=None):
+    """User-side Unblind over a batch: sigma_2 = c_tilde_2 - c_tilde_1^sk
+    (signature.rs:436-443), the scalar muls batched as a k=1 distinct MSM."""
+    from .backend import get_backend
+
+    if not blind_sigs:
+        return []
+    if backend is None:
+        backend = get_backend("python")
+    elif isinstance(backend, str):
+        backend = get_backend(backend)
+    msm = (
+        backend.msm_g1_distinct
+        if ctx.name == "G1"
+        else backend.msm_g2_distinct
+    )
+    a_sks = msm(
+        [[bs.blinded[0]] for bs in blind_sigs],
+        [[elgamal_sk]] * len(blind_sigs),
+    )
+    ops = ctx.sig
+    return [
+        Signature(bs.h, ops.sub(bs.blinded[1], a_sk))
+        for bs, a_sk in zip(blind_sigs, a_sks)
+    ]
+
+
 def fiat_shamir_challenge(transcript_bytes):
     """The challenge convention used at every reference call site
     (signature.rs:598, pok_sig.rs:94): hash the PoK transcript to Fr."""

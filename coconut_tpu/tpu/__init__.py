@@ -2,16 +2,11 @@
 
 Everything under this package is the TPU-native equivalent of the reference's
 `amcl_wrapper` curve layer (SURVEY.md §2.2) re-designed for XLA: 381-bit base
-field elements are decomposed into 24 x 16-bit limbs held in uint64 lanes,
-every operation is natively batched over leading array dimensions, control
-flow is `lax.scan` over the static BLS parameter bits, and the whole
-credential-verification hot path (reference signature.rs:472-478) compiles to
-one fused XLA program per batch shape.
-
-Requires 64-bit lane support (uint64 accumulators for the 16x16-bit limb
-products); enabled here before any tracing.
+field elements are decomposed into 48 x 8-bit limbs held in float32 lanes,
+limb products run as bf16 matmuls with exact f32 accumulation ON THE MXU
+(see tpu/limbs.py for why this representation), every operation is natively
+batched over leading array dimensions, control flow is `lax.scan` over the
+static BLS parameter bits, and the whole credential-verification hot path
+(reference signature.rs:472-478) compiles to one fused XLA program per batch
+shape. No 64-bit lane support is required — everything is f32/bf16/int32.
 """
-
-import jax
-
-jax.config.update("jax_enable_x64", True)

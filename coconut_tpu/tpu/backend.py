@@ -62,6 +62,13 @@ def _build_tables(spec_ops, bases):
     )
 
 
+def _r128_digits(r):
+    """128-bit combiner scalar -> 32 4-bit window digits, msb first."""
+    return np.array(
+        [(r >> (4 * i)) & 0xF for i in range(31, -1, -1)], dtype=np.uint32
+    )
+
+
 def _digits(scalars_batch):
     return jnp.asarray(
         np.stack(
@@ -86,15 +93,17 @@ def _pairing_kernel(px, py, qx, qy, valid):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
-def _fused_verify_kernel(sig_is_g1, tables, digits, s1, s2n, gtx, gty, inf1, inf2):
-    """Fused batch verify: MSM accumulator + 2-pair pairing product.
+def _msm_distinct_affine_kernel(field_is_fp2, x, y, inf, digits):
+    fl = cv.FP2 if field_is_fp2 else cv.FP
+    acc = cv.msm_distinct(fl, x, y, inf, digits)
+    return cv.to_affine(fl, acc)
 
-    sig_is_g1: signatures live in G1 (ctx "G1") — accumulator is in G2;
-    otherwise roles flip. s1/s2n: sigma_1 and -sigma_2 coordinate pytrees
-    [B]; gtx/gty: g_tilde affine coordinates pre-encoded as limb pytrees;
-    inf1/inf2: identity masks for sigma_1 / sigma_2."""
+
+def verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2):
+    """Post-MSM half of the fused verify: normalize the accumulator and run
+    the 2-pair pairing product. Split out so the sharded path (shard.py) can
+    combine cross-device MSM partials before entering it."""
     acc_fl = cv.FP2 if sig_is_g1 else cv.FP
-    acc = cv.msm_shared(acc_fl, tables, digits)
     ax, ay, ainf = cv.to_affine(acc_fl, acc)
 
     def stack2(a, b):
@@ -123,6 +132,126 @@ def _fused_verify_kernel(sig_is_g1, tables, digits, s1, s2n, gtx, gty, inf1, inf
     valid = ~(pinf | qinf)
     one = pr.pairing_product_is_one(px, py, qx, qy, valid)
     return one & ~inf1
+
+
+def fused_verify(sig_is_g1, tables, digits, s1, s2n, gtx, gty, inf1, inf2):
+    """Fused batch verify: MSM accumulator + 2-pair pairing product.
+
+    sig_is_g1: signatures live in G1 (ctx "G1") — accumulator is in G2;
+    otherwise roles flip. s1/s2n: sigma_1 and -sigma_2 coordinate pytrees
+    [B]; gtx/gty: g_tilde affine coordinates pre-encoded as limb pytrees;
+    inf1/inf2: identity masks for sigma_1 / sigma_2."""
+    acc_fl = cv.FP2 if sig_is_g1 else cv.FP
+    acc = cv.msm_shared(acc_fl, tables, digits)
+    return verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2)
+
+
+_fused_verify_kernel = functools.partial(jax.jit, static_argnums=(0,))(
+    fused_verify
+)
+
+
+def _tree_fold_points(fl, pts, n):
+    """Jacobian sum of a [n]-leading pytree by halving jadds (n pow2)."""
+    while n > 1:
+        half = n // 2
+        a = jax.tree_util.tree_map(lambda t: t[:half], pts)
+        b = jax.tree_util.tree_map(lambda t: t[half:], pts)
+        pts = cv.jadd(fl, a, b)
+        n = half
+    return pts
+
+
+def _tree_fold_fp12(f, n):
+    """Product of a [n]-leading Fp12 pytree by halving muls (n pow2)."""
+    while n > 1:
+        half = n // 2
+        a = jax.tree_util.tree_map(lambda t: t[:half], f)
+        b = jax.tree_util.tree_map(lambda t: t[half:], f)
+        f = tw.fp12_mul(a, b)
+        n = half
+    return f
+
+
+def fused_verify_combined(
+    sig_is_g1, tables, digits, s1, s2n, rdigits, gtx, gty, inf1, inf2
+):
+    """Probabilistic combined batch verify — ONE boolean for the whole batch.
+
+    Standard small-exponents batch verification: with random 128-bit r_i,
+
+      prod_i [ e(sigma_1_i, acc_i) * e(-sigma_2_i, g_tilde) ]^{r_i} == 1
+      ==  prod_i e(r_i sigma_1_i, acc_i)  *  e(sum_i r_i (-sigma_2_i), g_tilde)
+
+    so the batch costs B+1 Miller pairs and ONE shared final exponentiation
+    instead of 2B pairs + B final exps (the per-credential kernel
+    `fused_verify`). A forged credential escapes detection with probability
+    2^-128. Identity masks must be rejected host-side (the kernel treats
+    masked lanes as factor 1).
+
+    B must be a power of two (host pads with valid=False lanes)."""
+    acc_fl = cv.FP2 if sig_is_g1 else cv.FP
+    sig_fl = cv.FP if sig_is_g1 else cv.FP2
+    B = inf1.shape[0]
+
+    acc = cv.msm_shared(acc_fl, tables, digits)
+    ax, ay, ainf = cv.to_affine(acc_fl, acc)
+
+    def add_k1(pt):
+        return jax.tree_util.tree_map(lambda t: t[:, None], pt)
+
+    # r_i * sigma_1_i and r_i * (-sigma_2_i): k=1 distinct MSMs, 32 windows
+    s1r = cv.msm_distinct(
+        sig_fl, add_k1(s1[0]), add_k1(s1[1]), inf1[:, None], rdigits
+    )
+    s2rn = cv.msm_distinct(
+        sig_fl, add_k1(s2n[0]), add_k1(s2n[1]), inf2[:, None], rdigits
+    )
+    # mask invalid lanes to the identity so they drop out of the sum
+    dead = inf1 | inf2 | ainf
+    s2rn = tuple(
+        sig_fl.select(dead, i_, c)
+        for i_, c in zip(cv.jinfinity(sig_fl, (B,)), s2rn)
+    )
+    s2sum = jax.tree_util.tree_map(
+        lambda t: t[0], _tree_fold_points(sig_fl, s2rn, B)
+    )
+    sx, sy, sinf = cv.to_affine(sig_fl, s1r)
+    zx, zy, zinf = cv.to_affine(sig_fl, s2sum)
+
+    # B+1 miller pairs: (r_i sigma_1_i, acc_i) for each i, then
+    # (sum_i r_i (-sigma_2_i), g_tilde) appended as one extra lane
+    def cat(a, b):
+        return jax.tree_util.tree_map(
+            lambda x, y: jnp.concatenate([x, y[None]], axis=0), a, b
+        )
+
+    if sig_is_g1:
+        px, py = cat(sx, zx), cat(sy, zy)
+        qx, qy = cat(ax, gtx), cat(ay, gty)
+    else:
+        px, py = cat(ax, gtx), cat(ay, gty)
+        qx, qy = cat(sx, zx), cat(sy, zy)
+    valid = jnp.concatenate([~dead & ~sinf, ~zinf[None]], axis=0)
+    # miller over a [B+1, 1] pair-set shape (npairs = 1: nothing to fold)
+    f = pr.multi_miller_loop(
+        jax.tree_util.tree_map(lambda t: t[:, None], px),
+        jax.tree_util.tree_map(lambda t: t[:, None], py),
+        jax.tree_util.tree_map(lambda t: t[:, None], qx),
+        jax.tree_util.tree_map(lambda t: t[:, None], qy),
+        valid[:, None],
+    )  # -> [B+1] fp12
+    head = jax.tree_util.tree_map(lambda t: t[:B], f)
+    tail = jax.tree_util.tree_map(lambda t: t[B:], f)
+    prod = tw.fp12_mul(_tree_fold_fp12(head, B), tail)
+    ok = tw.fp12_is_one(pr.final_exp(prod))[0]
+    # any dead lane (identity sigma or accumulator) fails the whole batch
+    return ok & ~jnp.any(inf1 | inf2 | ainf)
+
+
+_fused_verify_combined_kernel = functools.partial(
+    jax.jit, static_argnums=(0,)
+)(fused_verify_combined)
 
 
 class JaxBackend(CurveBackend):
@@ -166,6 +295,32 @@ class JaxBackend(CurveBackend):
     def msm_g2_shared(self, bases, scalars_batch):
         return self._msm_shared(_sg2, True, bases, scalars_batch)
 
+    def _msm_distinct(self, is_fp2, points_batch, scalars_batch):
+        flat_pts = [p for row in points_batch for p in row]
+        B = len(points_batch)
+        k = len(points_batch[0])
+        if any(len(row) != k for row in points_batch):
+            raise ValueError("ragged distinct-MSM batch")
+        if is_fp2:
+            (x, y), inf = self._encode_g2_points(flat_pts)
+        else:
+            (x, y), inf = self._encode_g1_points(flat_pts)
+        reshape = lambda t: t.reshape((B, k) + t.shape[1:])
+        x, y = jax.tree_util.tree_map(reshape, (x, y))
+        inf = inf.reshape(B, k)
+        digits = _digits(scalars_batch)
+        ax, ay, ainf = _msm_distinct_affine_kernel(is_fp2, x, y, inf, digits)
+        xs = tw.decode_batch(ax)
+        ys = tw.decode_batch(ay)
+        infs = np.asarray(ainf)
+        return [None if i else (xv, yv) for xv, yv, i in zip(xs, ys, infs)]
+
+    def msm_g1_distinct(self, points_batch, scalars_batch):
+        return self._msm_distinct(False, points_batch, scalars_batch)
+
+    def msm_g2_distinct(self, points_batch, scalars_batch):
+        return self._msm_distinct(True, points_batch, scalars_batch)
+
     def pairing_product_is_one(self, pairs_batch):
         B = len(pairs_batch)
         n = len(pairs_batch[0])
@@ -184,11 +339,20 @@ class JaxBackend(CurveBackend):
 
     # -- fused hot path ------------------------------------------------------
 
-    def batch_verify(self, sigs, messages_list, vk, params):
-        """Fully-fused batched PS verification (the north-star path)."""
+    def encode_verify_batch(self, sigs, messages_list, vk, params, pad_bases_to=None):
+        """Host-side encoding of a verify batch into the fused-kernel operand
+        tuple (tables, digits, s1, s2n, gtx, gty, inf1, inf2).
+
+        pad_bases_to: pad the shared-base axis (with identity bases / zero
+        scalars) up to this length — the sharded path needs the base count
+        divisible by the MSM mesh axis."""
         ctx = params.ctx
         bases = [vk.X_tilde] + list(vk.Y_tilde)
         scalars = [[1] + [m % R for m in msgs] for msgs in messages_list]
+        if pad_bases_to is not None and len(bases) < pad_bases_to:
+            npad = pad_bases_to - len(bases)
+            bases = bases + [None] * npad
+            scalars = [row + [0] * npad for row in scalars]
         tables = _build_tables(ctx.other, bases)
         digits = _digits(scalars)
 
@@ -208,15 +372,74 @@ class JaxBackend(CurveBackend):
 
             gtx = jnp.asarray(fp_encode(params.g_tilde[0]))
             gty = jnp.asarray(fp_encode(params.g_tilde[1]))
-        bits = _fused_verify_kernel(
-            ctx.name == "G1",
+        return (tables, digits, s1, s2n, gtx, gty, inf1, inf2)
+
+    def batch_verify(self, sigs, messages_list, vk, params):
+        """Fully-fused batched PS verification (the north-star path)."""
+        from .. import metrics
+
+        with metrics.timer("encode"):
+            operands = self.encode_verify_batch(sigs, messages_list, vk, params)
+            metrics.count(
+                "transfer_bytes",
+                sum(
+                    t.size * t.dtype.itemsize
+                    for t in jax.tree_util.tree_leaves(operands)
+                    if hasattr(t, "size")
+                ),
+            )
+        with metrics.timer("kernel"):
+            bits = _fused_verify_kernel(params.ctx.name == "G1", *operands)
+            bits.block_until_ready()
+        with metrics.timer("readback"):
+            out = [bool(b) for b in np.asarray(bits)]
+        metrics.count("verifies", len(out))
+        metrics.count("batches")
+        return out
+
+    def batch_verify_combined(self, sigs, messages_list, vk, params):
+        """One boolean for the whole batch via small-exponents combination
+        (see fused_verify_combined): ~half the Miller work and 1/B of the
+        final-exponentiation work of `batch_verify`. Probabilistic: a forged
+        credential passes with probability 2^-128. Batch is padded to a
+        power of two with dead lanes."""
+        import secrets
+
+        B = len(sigs)
+        if B == 0:
+            return True  # empty product is 1
+        Bp = 1 << max(1, (B - 1).bit_length())
+        if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
+            return False
+        pad = Bp - B
+        if pad:
+            sigs = sigs + [sigs[0]] * pad
+            messages_list = list(messages_list) + [messages_list[0]] * pad
+        operands = self.encode_verify_batch(sigs, messages_list, vk, params)
+        tables, digits, s1, s2n, gtx, gty, inf1, inf2 = operands
+        rs = [secrets.randbits(128) for _ in range(Bp)]
+        rdigits = jnp.asarray(
+            np.stack([_r128_digits(r) for r in rs])[:, None, :]
+        )
+        ok = _fused_verify_combined_kernel(
+            params.ctx.name == "G1",
             tables,
             digits,
             s1,
             s2n,
+            rdigits,
             gtx,
             gty,
             inf1,
             inf2,
         )
-        return [bool(b) for b in np.asarray(bits)]
+        return bool(ok)
+
+    def batch_verify_sharded(self, sigs, messages_list, vk, params, mesh, **kw):
+        """Multi-chip variant: dp-sharded credentials, tp-sharded MSM bases
+        over `mesh` (see tpu/shard.py)."""
+        from . import shard
+
+        return shard.batch_verify_sharded(
+            self, sigs, messages_list, vk, params, mesh, **kw
+        )

@@ -56,7 +56,9 @@ class _FpField:
 
     @staticmethod
     def zeros(shape=()):
-        return jnp.zeros(tuple(shape) + (24,), dtype=jnp.uint64)
+        from .limbs import NLIMBS
+
+        return jnp.zeros(tuple(shape) + (NLIMBS,), dtype=jnp.float32)
 
     ones = staticmethod(fp.ones_mont)
 
@@ -154,6 +156,72 @@ def gather_point(table, idx):
     return jax.tree_util.tree_map(lambda t: jnp.take(t, idx, axis=0), table)
 
 
+def affine_to_jacobian(fl, x, y, inf):
+    """Affine pytree + infinity mask -> Jacobian (identity = (1, 1, 0))."""
+    one = fl.ones(inf.shape)
+    zero = fl.zeros(inf.shape)
+    return (
+        fl.select(inf, one, x),
+        fl.select(inf, one, y),
+        fl.select(inf, zero, one),
+    )
+
+
+def build_tables_device(fl, x, y, inf):
+    """On-device per-point multiples 0..15 for the distinct-base MSM.
+
+    x, y: affine coordinate pytrees [..., k]; inf: bool [..., k].
+    Returns Jacobian pytree with leaves [..., k, 16, NLIMBS-ish] (a new axis
+    inserted before the limb dims). 14 batched jadds — amortized over the
+    whole [..., k] batch, unlike the host-side spec-op tables of msm_shared
+    (those are only viable when the bases are shared by every batch row)."""
+    jac = affine_to_jacobian(fl, x, y, inf)
+    rows = [jinfinity(fl, inf.shape), jac]
+    for _ in range(14):
+        rows.append(jadd(fl, rows[-1], jac))
+    return jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls, axis=inf.ndim), *rows
+    )
+
+
+def msm_distinct(fl, x, y, inf, digits):
+    """Windowed MSM over per-row bases (the issuance shape: every credential
+    request carries its own ciphertext points — reference signature.rs:400-428
+    — so there is no shared table).
+
+    x, y, inf: affine points [..., k]; digits: uint [..., k, nwin] 4-bit
+    windows, most significant first (zero scalars -> all-zero digits).
+    Returns a Jacobian accumulator pytree with leading dims [...]."""
+    tables = build_tables_device(fl, x, y, inf)
+    k = inf.shape[-1]
+    acc = jinfinity(fl, inf.shape[:-1])
+
+    def body(acc, dw):
+        # dw: [..., k] digits of this window
+        acc = jax.lax.fori_loop(0, 4, lambda _, a: jdouble(fl, a), acc)
+
+        def add_base(j, a):
+            idx = jnp.take(dw, j, axis=-1)  # [...]
+            entry = jax.tree_util.tree_map(
+                lambda t: jnp.squeeze(
+                    jnp.take_along_axis(
+                        jnp.take(t, j, axis=idx.ndim),
+                        idx.reshape(idx.shape + (1,) * (t.ndim - idx.ndim - 1)),
+                        axis=idx.ndim,
+                    ),
+                    axis=idx.ndim,
+                ),
+                tables,
+            )
+            return jadd(fl, a, entry)
+
+        acc = jax.lax.fori_loop(0, k, add_base, acc)
+        return acc, None
+
+    acc, _ = jax.lax.scan(body, acc, jnp.moveaxis(digits, -1, 0))
+    return acc
+
+
 def msm_shared(fl, tables, digits):
     """Windowed shared-base MSM.
 
@@ -162,19 +230,29 @@ def msm_shared(fl, tables, digits):
       from the spec ops so table contents are trusted.
     digits: uint array [B, k, nwin] — 4-bit windows, most significant first.
     Returns Jacobian accumulator pytree with leading [B].
+
+    Compile-size discipline: the window loop is a `scan` and the doubling /
+    per-base-add loops are `fori_loop`s, so jdouble and jadd are each
+    compiled exactly ONCE regardless of window count or base count.
     """
     B, k, nwin = digits.shape
     acc = jinfinity(fl, (B,))
 
     def body(acc, dw):
         # dw: [B, k] digits for this window
-        for _ in range(4):
-            acc = jdouble(fl, acc)
-        for j in range(k):
-            entry = gather_point(
-                jax.tree_util.tree_map(lambda t: t[j], tables), dw[:, j]
+        acc = jax.lax.fori_loop(0, 4, lambda _, a: jdouble(fl, a), acc)
+
+        def add_base(j, a):
+            row = jax.tree_util.tree_map(
+                lambda t: jax.lax.dynamic_index_in_dim(
+                    t, j, axis=0, keepdims=False
+                ),
+                tables,
             )
-            acc = jadd(fl, acc, entry)
+            entry = gather_point(row, jnp.take(dw, j, axis=1))
+            return jadd(fl, a, entry)
+
+        acc = jax.lax.fori_loop(0, k, add_base, acc)
         return acc, None
 
     acc, _ = jax.lax.scan(body, acc, jnp.moveaxis(digits, -1, 0))

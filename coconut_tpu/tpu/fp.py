@@ -1,22 +1,38 @@
-"""Batched Fp (BLS12-381 base field) arithmetic on 16-bit limbs in uint64.
+"""Batched Fp (BLS12-381 base field) arithmetic on balanced 8-bit limbs in f32.
 
 Every function operates on arrays of shape [..., NLIMBS] (leading dims =
-batch) in the Montgomery domain (R = 2^384) and returns canonical
-representatives (< p, 16-bit limbs).
+batch). Elements are in the Montgomery domain (R = 2^384) in a REDUNDANT
+balanced representation:
 
-XLA-friendly formulation (SURVEY.md §7 hard part (a), revised after
-profiling: per-limb update-slice chains made compile time explode):
+  value = sum_i limb_i * 256^i,  limb_i in [-135, 135],  value in [0, B_MAX)
 
-  - schoolbook products: one outer product + one static 0/1 matrix
-    contraction (einsum) — no sequential limb loop;
-  - Montgomery reduction in full width: m = (t * N') mod 2^384 via a
-    truncated schoolbook, then (t + m*p) / 2^384 — no word-by-word REDC;
-  - carry/borrow propagation: carry-lookahead via lax.associative_scan
-    (the (generate, propagate) monoid), log-depth and exact — no ripple.
+with B_MAX (~2p) chosen so B_MAX^2 <= R*p — Montgomery reduction stays valid
+without ever producing a canonical (< p) value. Canonicalization happens on
+the host (decode reduces mod p) and inside the exact predicates `eq` /
+`is_zero` only.
 
-Magnitude discipline (uint64 headroom): 16x16-bit products accumulated over
-<= 24 terms stay < 2^37; the one redundant-times-16-bit product in the
-reduction stays < 2^58. All bounds are commented at the use sites.
+Why this representation (SURVEY.md §7 hard part (a), third redesign):
+
+  - schoolbook limb products run ON THE MXU: outer product (exact f32,
+    |products| <= 135^2 < 2^15), split into two balanced byte planes
+    (|.| <= 128, exact bf16), each contracted with a static 0/1 band matrix
+    via bf16 matmuls with exact f32 accumulation (sums of <= 48 terms).
+  - NO carry/borrow scans anywhere: balanced limbs converge under the
+    shift/round "light pass" (|limb| drops 256x per pass to a <= 130 fixed
+    band) with no 0xFF-chain plateau, unlike non-negative limbs which need
+    carry-lookahead — the previous design spent 75% of its HLO (and tens of
+    minutes of XLA compile time) on `lax.associative_scan` carry fixes.
+  - exact zero test without canonicalization: once |limb| <= 254, a nonzero
+    limb k dominates the lower tail (|sum_{i<k} limb_i 256^i| < 256^k), so
+    value == 0  <=>  every limb == 0 (downward induction). `eq`/`is_zero`
+    test the handful of multiples of p their bounded ranges allow.
+  - signed-carry safety: a light pass drops the carry out of the top buffer
+    limb, so every normalization that must preserve the full value runs in a
+    buffer extended by `_EXTRA` limbs; value bounds (commented per site)
+    prove the extension limbs end at zero — except where truncation mod
+    2^384 is intended (the two inner REDC normalizations).
+
+The import-time asserts pin the exact bounds the algebra relies on.
 """
 
 import numpy as np
@@ -25,93 +41,131 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.fields import P
-from .limbs import LIMB_BITS, MASK, MONT_R, NLIMBS, ONE_M, P_LIMBS, int_to_limbs
+from .limbs import MONT_R, NLIMBS, balanced_limbs
 
-_P_J = jnp.asarray(P_LIMBS, dtype=jnp.uint64)
-_ONE_M_J = jnp.asarray(ONE_M, dtype=jnp.uint64)
-# N' = -p^{-1} mod 2^384, full width (for the one-shot Montgomery m).
+# --- bounds (exact integer arithmetic at import time) -----------------------
+
+# Top estimate uses limbs 46..48: s = l48*2^16 + l47*2^8 + l46 approximates
+# value/2^368 with error |tail| <= TAIL (the 46 lower balanced limbs).
+_TAIL = 135 * ((256**46 - 1) // 255)
+# masked subtract of 2p is safe (value certainly >= 2p) when s >= THRESH:
+_THRESH = (2 * P + _TAIL) // (1 << (8 * 46)) + 1
+# and a value that misses the test is certainly below B_MAX:
+B_MAX = _THRESH * (1 << (8 * 46)) + _TAIL
+
+assert _THRESH * (1 << (8 * 46)) - _TAIL >= 2 * P  # safety of the subtract
+assert B_MAX * B_MAX <= MONT_R * P  # Montgomery reduction valid
+# mul output bound: t/R + |m|*p/R + p  with |m| <= 0.51*2^384:
+assert B_MAX * B_MAX // MONT_R + P * 51 // 100 + P + 4 < B_MAX
+# add/sub enter _reduce with value < max(2*B_MAX, B_MAX + 4p); each masked
+# round either certifies value < B_MAX (miss, by construction of B_MAX) or
+# subtracts 2p; three rounds therefore always land below B_MAX:
+assert 2 * B_MAX - 6 * P < B_MAX and B_MAX + 4 * P - 6 * P < B_MAX
+# slicing the 4p constant to 48 limbs must not drop a top carry:
+assert all(v == 0.0 for v in balanced_limbs(4 * P, NLIMBS + 1)[NLIMBS:])
+
+_BASE = 256.0
+_INV_BASE = 1.0 / 256.0
+_EXTRA = 3  # buffer headroom: carries travel <= 1 limb per pass, 3 passes
+
+_P2_J = jnp.asarray(balanced_limbs(2 * P, NLIMBS + _EXTRA), dtype=jnp.float32)
+_P_BAL_J = jnp.asarray(balanced_limbs(P), dtype=jnp.float32)
 _NPRIME_J = jnp.asarray(
-    int_to_limbs((-pow(P, -1, MONT_R)) % MONT_R), dtype=jnp.uint64
+    balanced_limbs((-pow(P, -1, MONT_R)) % MONT_R, wrap=True),
+    dtype=jnp.float32,
 )
-_MASK = jnp.uint64(MASK)
-_SHIFT = jnp.uint64(LIMB_BITS)
+_ONE_M_J = jnp.asarray(balanced_limbs(MONT_R % P), dtype=jnp.float32)
+# candidate multiples of p for the exact predicates (49-limb buffers: 5p..6p
+# exceed what 48 balanced limbs can represent)
+_PK_J = [
+    jnp.asarray(balanced_limbs(k * P, NLIMBS + 1), dtype=jnp.float32)
+    for k in range(7)
+]
+
+# Static band matrix: BAND[i*NLIMBS + j, k] = 1 iff i + j == k.
+_BAND_NP = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS - 1), dtype=np.float32)
+for _i in range(NLIMBS):
+    for _j in range(NLIMBS):
+        _BAND_NP[_i * NLIMBS + _j, _i + _j] = 1.0
+_BAND = jnp.asarray(_BAND_NP, dtype=jnp.bfloat16)
+
 
 def _school(a, b, out_len):
-    """Polynomial limb product c_k = sum_i a_i * b_{k-i}, truncated to
-    out_len limbs, via statically shifted copies of b and one reduction —
-    no integer dot_general (unsupported for u64 by the TPU X64 rewriter).
-    a, b: [..., N] with limb magnitudes small enough that 24 accumulated
-    pairwise products fit uint64 (callers document bounds)."""
-    rows = []
-    for i in range(NLIMBS):
-        left = min(i, out_len)
-        right = max(out_len - NLIMBS - left, 0)
-        keep = out_len - left - right
-        row = b[..., :keep]
-        pad = [(0, 0)] * (b.ndim - 1) + [(left, right)]
-        rows.append(jnp.pad(row, pad))
-    stacked = jnp.stack(rows, axis=-2)  # [..., N, out_len]
-    return jnp.sum(a[..., :, None] * stacked, axis=-2)
-
-
-# --- carry machinery --------------------------------------------------------
-
-
-def _gp_combine(lo, hi):
-    """The carry-lookahead monoid on (generate, propagate) bit pairs."""
-    g1, p1 = lo
-    g2, p2 = hi
-    return (g2 | (p2 & g1), p1 & p2)
-
-
-def _carry_fix(s):
-    """Exact carry propagation for limbs in [0, 2^16] (at most 1-bit carry):
-    returns 16-bit limbs; the final carry-out is dropped (callers guarantee
-    the value fits the buffer)."""
-    g = (s >> _SHIFT) != 0
-    p = (s & _MASK) == _MASK
-    G, _ = lax.associative_scan(_gp_combine, (g, p), axis=-1)
-    carry_in = jnp.concatenate(
-        [jnp.zeros_like(G[..., :1]), G[..., :-1]], axis=-1
+    """Polynomial limb product c_k = sum_{i+j=k} a_i * b_j, truncated to
+    out_len limbs. |a_i|,|b_j| <= 135: outer products <= 135^2 < 2^15 (exact
+    f32); balanced byte planes <= 128 in magnitude (exact bf16); band sums
+    <= 48*128 (exact f32 accumulation on the MXU); recombined coefficients
+    <= 48*135^2 < 2^20 (exact f32)."""
+    outer = a[..., :, None] * b[..., None, :]
+    flat = outer.reshape(outer.shape[:-2] + (NLIMBS * NLIMBS,))
+    hi = jnp.round(flat * _INV_BASE)
+    lo = flat - hi * _BASE
+    band = _BAND[:, :out_len]
+    acc_lo = jnp.einsum(
+        "...x,xk->...k",
+        lo.astype(jnp.bfloat16),
+        band,
+        preferred_element_type=jnp.float32,
     )
-    return (s + carry_in) & _MASK
+    acc_hi = jnp.einsum(
+        "...x,xk->...k",
+        hi.astype(jnp.bfloat16),
+        band,
+        preferred_element_type=jnp.float32,
+    )
+    return acc_lo + acc_hi * _BASE
 
 
-def _norm_exact(t, buf):
-    """Redundant limbs (< 2^58) -> exact 16-bit limbs in a `buf`-limb buffer.
-    The represented value must be < 2^(16*buf)."""
-    pad = buf - t.shape[-1]
-    if pad > 0:
-        t = jnp.concatenate(
-            [t, jnp.zeros(t.shape[:-1] + (pad,), dtype=jnp.uint64)], axis=-1
-        )
-    # three halving passes: 2^58 -> 2^42+ -> 2^26+ -> <= 2^16
+def _shift_up(hi):
+    """Move per-limb carries one limb up. Drops the top limb's carry —
+    callers either extend the buffer (value-preserving sites) or intend
+    truncation mod 2^(8*buflen) (the inner REDC sites)."""
+    return jnp.concatenate([jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+
+
+def _pass(t):
+    """One balanced shift/round pass: exact (power-of-two scalings and
+    integer adds below 2^24), |limb| drops 256x toward the <= 130 band."""
+    hi = jnp.round(t * _INV_BASE)
+    lo = t - hi * _BASE
+    return lo + _shift_up(hi)
+
+
+def _norm(t, passes=3):
+    """|limbs| < 2^21 -> |limbs| <= 130 (value preserved up to top-limb
+    truncation; see _shift_up). Pass bounds: 2^21 -> 128+2^13 -> 128+33 ->
+    128+2."""
+    for _ in range(passes):
+        t = _pass(t)
+    return t
+
+
+def _ext(t, extra):
+    return jnp.concatenate(
+        [t, jnp.zeros(t.shape[:-1] + (extra,), dtype=jnp.float32)], axis=-1
+    )
+
+
+def _top_estimate(t):
+    """s ~= value/2^368 from limbs 46..48 (post-_norm: |l48| <= 1 whenever
+    value < 2^384, so |s| < 2^17 — exact f32)."""
+    return (
+        t[..., NLIMBS] * 65536.0
+        + t[..., NLIMBS - 1] * _BASE
+        + t[..., NLIMBS - 2]
+    )
+
+
+def _reduce(t):
+    """Post-add/sub reduction in an extended buffer: value < 2*B_MAX + 4p ->
+    value < B_MAX, |limbs| <= 130, sliced back to 48 limbs (value < B_MAX
+    < 2^383 forces the extension limbs to zero)."""
+    t = _norm(_ext(t, _EXTRA))
     for _ in range(3):
-        lo = t & _MASK
-        hi = t >> _SHIFT
-        t = lo + jnp.concatenate(
-            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
-        )
-    return _carry_fix(t)
-
-
-def _borrow_scan(a, b):
-    """Borrow-lookahead for a - b per 16-bit limb vectors: returns
-    (difference limbs mod 2^16, full-width borrow bool)."""
-    bg = a < b
-    bp = a == b
-    BG, _ = lax.associative_scan(_gp_combine, (bg, bp), axis=-1)
-    borrow_in = jnp.concatenate(
-        [jnp.zeros_like(BG[..., :1]), BG[..., :-1]], axis=-1
-    )
-    d = (a - b - borrow_in.astype(jnp.uint64)) & _MASK
-    return d, BG[..., -1]
-
-
-def _cond_sub_p(r):
-    """r (16-bit limbs, value < 2p) -> r mod p, canonical."""
-    d, borrow = _borrow_scan(r, _P_J)
-    return jnp.where(borrow[..., None], r, d)
+        mask = _top_estimate(t) >= float(_THRESH)
+        t = t - jnp.where(mask[..., None], _P2_J, 0.0)
+        t = _pass(t)
+    return t[..., :NLIMBS]
 
 
 # --- public ops -------------------------------------------------------------
@@ -126,55 +180,41 @@ def ones_mont(shape=()):
 
 
 def add(a, b):
-    s = a + b  # <= 2^17 - 2 per limb
-    lo = s & _MASK
-    hi = s >> _SHIFT
-    s = lo + jnp.concatenate(
-        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
-    )  # <= 2^16: 1-bit carries now
-    return _cond_sub_p(_carry_fix(s))
+    return _reduce(a + b)  # |limbs| <= 270; value < 2*B_MAX
 
 
 def sub(a, b):
-    d, borrow = _borrow_scan(a, b)
-    # underflow lanes: add p back (value wraps mod 2^384; carry-out drops)
-    s = d + _P_J
-    lo = s & _MASK
-    hi = s >> _SHIFT
-    s = lo + jnp.concatenate(
-        [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1
-    )
-    dp = _carry_fix(s)
-    return jnp.where(borrow[..., None], dp, d)
+    # +4p keeps the value positive (B_MAX < 4p); range (4p-B_MAX, B_MAX+4p)
+    return _reduce(a - b + _PK_J[4][..., :NLIMBS])
 
 
 def neg(a):
-    return sub(zeros_like(a), a)
+    return _reduce(_PK_J[4][..., :NLIMBS] - a)
 
 
 def mul(a, b):
-    """Montgomery product a * b * 2^-384 mod p, canonical output.
+    """Montgomery product a * b * 2^-384 mod p; values < B_MAX in/out.
 
-    Inputs: canonical 16-bit limbs (< p)."""
-    t = _school(a, b, 2 * NLIMBS - 1)  # 47 limbs < 24*2^32 = 2^36.6
-    # m = t * N' mod 2^384: truncated product of redundant t_lo by 16-bit N'
-    # -> limbs < 24 * 2^36.6 * 2^16 = 2^57.2; normalize to a true value
-    # < 2^384 before multiplying by p (REDC requires m < R).
-    m_red = _school(t[..., :NLIMBS], _NPRIME_J, NLIMBS)
-    m = _norm_exact(m_red, buf=NLIMBS + 4)[..., :NLIMBS]  # mod 2^384, 16-bit
-    u = _school(m, _P_J, 2 * NLIMBS - 1)  # 47 limbs < 2^36.6
-    # t + m*p: divisible by 2^384; high half plus the low half's carry-out.
-    w = t + u  # limbs < 2^37.6
-    lo_norm = _norm_exact(w[..., :NLIMBS], buf=NLIMBS + 3)
-    # limbs [0:24] of lo_norm are zero (REDC exactness); [24:27] are the
-    # carry into the high half.
-    hi = w[..., NLIMBS:]  # 23 limbs < 2^37.6
-    hi = jnp.concatenate(
-        [hi, jnp.zeros(hi.shape[:-1] + (1,), dtype=jnp.uint64)], axis=-1
-    )
-    hi = hi.at[..., :3].add(lo_norm[..., NLIMBS : NLIMBS + 3])
-    r = _norm_exact(hi, buf=NLIMBS)  # value < 2p < 2^382: fits 24 limbs
-    return _cond_sub_p(r)
+    REDC with balanced m: t = a*b; m = (t mod 2^384)*N' mod 2^384 (balanced,
+    |m| <= 0.51*2^384 < R); result = (t + m*p + p*R)/2^384 — the p*R term
+    keeps the numerator nonnegative despite m's sign (it adds p, still 0
+    mod p, to the quotient). Output < B_MAX^2/R^2*... see import asserts."""
+    t = _school(a, b, 2 * NLIMBS - 1)  # |limbs| < 2^20
+    tlo = _norm(t[..., :NLIMBS])  # t mod 2^384 (truncation intended)
+    m = _norm(_school(tlo, _NPRIME_J, NLIMBS))  # |value| <= 0.51*2^384
+    u = _school(m, _P_BAL_J, 2 * NLIMBS - 1)  # m*p, |limbs| < 2^20
+    w = t + u  # |limbs| < 2^21; value = t + m*p, divisible by 2^384
+    # Low half in a value-preserving extended buffer: after _norm the limbs
+    # [0:48] are exactly zero (value divisible by 2^384, |limbs| <= 130 —
+    # upward induction mod 256), and [48:51] hold the carry into the high
+    # half (|carry| = |w_lo|/2^384 <= 2^21*2^377/2^384 < 2^15).
+    lo = _norm(_ext(w[..., :NLIMBS], _EXTRA))
+    hi = _ext(w[..., NLIMBS:], 1)  # 47 -> 48 limbs
+    hi = hi + _P_BAL_J  # the +p*R quotient term (nonnegativity)
+    hi = hi.at[..., : _EXTRA].add(lo[..., NLIMBS : NLIMBS + _EXTRA])
+    # value < B_MAX^2/R + 0.51p + p < 2.6p < B_MAX (import assert): the
+    # extension limbs normalize to zero, slice back.
+    return _norm(_ext(hi, _EXTRA))[..., :NLIMBS]
 
 
 def sq(a):
@@ -182,7 +222,8 @@ def sq(a):
 
 
 def mul_small(a, k):
-    """a * k for tiny static k (2..12) via an addition chain."""
+    """a * k for tiny static k (2..12) via an addition chain (each add
+    re-reduces, keeping the value < B_MAX)."""
     if k == 0:
         return zeros_like(a)
     if k == 1:
@@ -195,7 +236,7 @@ def mul_small(a, k):
 def pow_static(a, e):
     """a^e for a static positive int exponent, as a scan over its bits."""
     assert e > 0
-    bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=jnp.uint64)
+    bits = jnp.array([int(c) for c in bin(e)[2:]], dtype=jnp.int32)
 
     def body(acc, bit):
         acc = mul(acc, acc)
@@ -213,14 +254,51 @@ def inv(a):
     return pow_static(a, P - 2)
 
 
+# --- exact predicates -------------------------------------------------------
+
+
+def _is_zero_value(t):
+    """t in a 49-limb buffer, |limbs| <= 131 after _norm: value == 0 <=>
+    all limbs zero (a nonzero limb dominates the balanced tail below it)."""
+    return jnp.all(t == 0.0, axis=-1)
+
+
+def _is_multiple_of_p(t49, kmin, kmax):
+    """t49: 49-limb normalized buffer, value in (kmin*p - p, (kmax+1)*p):
+    test value == k*p for k in [kmin, kmax]."""
+    bits = None
+    for k in range(kmin, kmax + 1):
+        b = _is_zero_value(_norm(t49 - _PK_J[k], passes=2))
+        bits = b if bits is None else (bits | b)
+    return bits
+
+
 def is_zero(a):
-    return jnp.all(a == 0, axis=-1)
+    """a == 0 mod p (value in [0, B_MAX) => candidates {0, p, 2p})."""
+    return _is_multiple_of_p(_norm(_ext(a, 1), passes=1), 0, 2)
 
 
 def eq(a, b):
-    return jnp.all(a == b, axis=-1)
+    """a == b mod p. d = a - b + 4p is in (4p - B_MAX, 4p + B_MAX) subset
+    (p, 7p): candidates 2p..6p (1..6 kept for margin)."""
+    d = _norm(_ext(a - b, 1) + _PK_J[4], passes=2)
+    return _is_multiple_of_p(d, 1, 6)
 
 
 def select(mask, a, b):
     """mask [...] bool -> a where true else b (limb arrays)."""
     return jnp.where(mask[..., None], a, b)
+
+
+# --- stacked-multiply helper (the tower's compile-size lever) ---------------
+
+
+def mul_stack(lhs_list, rhs_list):
+    """Stack S independent products into ONE mul: [(a, b), ...] with shared
+    leading dims -> list of S products. Collapses the extension-tower's many
+    base-field multiplies into a single MXU contraction (compile-size and
+    MXU-utilization win; see tower.py)."""
+    L = jnp.stack(jnp.broadcast_arrays(*lhs_list), axis=-2)  # [..., S, N]
+    Rv = jnp.stack(jnp.broadcast_arrays(*rhs_list), axis=-2)
+    out = mul(L, Rv)
+    return [out[..., i, :] for i in range(len(lhs_list))]

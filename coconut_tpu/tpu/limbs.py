@@ -1,11 +1,16 @@
-"""Limb codec: python ints <-> 24 x 16-bit limbs in uint64 lanes.
+"""Limb codec: python ints <-> 48 x 8-bit limbs in float32 lanes.
 
 The limb decomposition is the host<->device wire format for all field
-elements (SURVEY.md §7 stage 6 "limb codec"). 16-bit limbs were chosen so
-that schoolbook products (16x16 -> 32 bits) accumulated over 24 terms plus
-Montgomery-reduction additions stay below 2^38 — comfortably inside a uint64
-accumulator with no carry splitting inside the inner loops (the hard part (a)
-in SURVEY.md §7: TPU-width-friendly carry discipline).
+elements (SURVEY.md §7 stage 6 "limb codec"). 8-bit BALANCED limbs (each in
+[-128, 128]) in float32 were chosen so the schoolbook limb products run on
+the MXU: products split into two exact bf16 byte planes, contracted against
+a static 0/1 band matrix with float32 accumulation — every intermediate is
+an integer below 2^24 and therefore EXACT in float32 (the systolic array
+becomes a bignum multiplier), and balanced carries normalize in a fixed
+number of shift/round passes with no carry-lookahead scans (see tpu/fp.py).
+This replaced a 16-bit-limbs-in-uint64 design whose emulated 64-bit VPU ops
+were ~70x slower and whose per-op HLO count made XLA compiles take tens of
+minutes.
 
 Least-significant limb first. Fp values travel in the Montgomery domain
 (a * 2^384 mod p) between kernels; encode/decode converts at the boundary so
@@ -16,55 +21,84 @@ import numpy as np
 
 from ..ops.fields import P, R
 
-LIMB_BITS = 16
-NLIMBS = 24  # 24 * 16 = 384 bits >= 381
+LIMB_BITS = 8
+NLIMBS = 48  # 48 * 8 = 384 bits >= 381
 MASK = (1 << LIMB_BITS) - 1
 MONT_BITS = LIMB_BITS * NLIMBS  # 384
 MONT_R = 1 << MONT_BITS
 
-# Fr scalars: 16 limbs of 16 bits = 256 bits >= 255
-FR_NLIMBS = 16
+DTYPE = np.float32
 
 
 def int_to_limbs(x, nlimbs=NLIMBS):
-    """Python int -> np.uint64[nlimbs], least-significant first."""
+    """Python int -> np.float32[nlimbs], least-significant first."""
     if not 0 <= x < (1 << (LIMB_BITS * nlimbs)):
         raise ValueError("value out of range for %d limbs" % nlimbs)
     return np.array(
-        [(x >> (LIMB_BITS * i)) & MASK for i in range(nlimbs)], dtype=np.uint64
+        [(x >> (LIMB_BITS * i)) & MASK for i in range(nlimbs)], dtype=DTYPE
     )
 
 
 def limbs_to_int(limbs):
-    """np/jnp uint array (last axis = limbs) -> python int (single element)."""
-    arr = np.asarray(limbs, dtype=np.uint64)
-    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr))
+    """np/jnp float array (last axis = limbs) -> python int (single element)."""
+    arr = np.asarray(limbs)
+    return sum(int(round(float(v))) << (LIMB_BITS * i) for i, v in enumerate(arr))
 
 
 def ints_to_limbs(xs, nlimbs=NLIMBS):
-    """[...] nested list of ints -> np.uint64[..., nlimbs]."""
-    a = np.asarray(
+    """[...] list of ints -> np.float32[..., nlimbs]."""
+    return np.array(
         [[int(x) >> (LIMB_BITS * i) & MASK for i in range(nlimbs)] for x in xs],
-        dtype=np.uint64,
+        dtype=DTYPE,
     )
-    return a
 
 
 def limbs_to_ints(arr):
-    """np.uint64[..., nlimbs] -> nested list of ints over the last axis."""
-    a = np.asarray(arr, dtype=np.uint64)
+    """np.float32[..., nlimbs] -> nested list of ints over the last axis."""
+    a = np.asarray(arr)
     flat = a.reshape(-1, a.shape[-1])
     out = [
-        sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(row)) for row in flat
+        sum(int(round(float(v))) << (LIMB_BITS * i) for i, v in enumerate(row))
+        for row in flat
     ]
-    return np.array(out, dtype=object).reshape(a.shape[:-1]).tolist() if a.ndim > 1 else out[0]
+    return (
+        np.array(out, dtype=object).reshape(a.shape[:-1]).tolist()
+        if a.ndim > 1
+        else out[0]
+    )
+
+
+# --- balanced representation ------------------------------------------------
+
+
+def balanced_limbs(x, nlimbs=NLIMBS, wrap=False):
+    """Nonnegative int -> balanced signed limbs (each in [-128, 128]) as
+    np.float32[nlimbs]. The device representation: see tpu/fp.py. With
+    `wrap`, a final carry is dropped (value taken mod 2^(8*nlimbs) — for
+    constants only used in mod-2^384 arithmetic, e.g. N')."""
+    digs = [(x >> (LIMB_BITS * i)) & MASK for i in range(nlimbs)]
+    if x >> (LIMB_BITS * nlimbs):
+        raise ValueError("value out of range for %d limbs" % nlimbs)
+    out = []
+    carry = 0
+    for d in digs:
+        v = d + carry
+        if v > 128:
+            v -= 256
+            carry = 1
+        else:
+            carry = 0
+        out.append(v)
+    if carry and not wrap:
+        raise ValueError("balanced form needs %d limbs + carry" % nlimbs)
+    return np.array(out, dtype=DTYPE)
 
 
 # --- Montgomery constants ---------------------------------------------------
 
 P_LIMBS = int_to_limbs(P)
-# -p^{-1} mod 2^16 (the REDC multiplier derivation constant)
-N0 = (-pow(P, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+# N' = -p^{-1} mod 2^384, full width (for the one-shot Montgomery m)
+NPRIME = int_to_limbs((-pow(P, -1, MONT_R)) % MONT_R)
 # R^2 mod p: multiply by this (Montgomery-mul) to enter the domain
 R2 = int_to_limbs(MONT_R * MONT_R % P)
 # Montgomery representation of 1 and 0
@@ -73,8 +107,8 @@ ZERO = int_to_limbs(0)
 
 
 def fp_encode(x):
-    """Canonical Fp int -> Montgomery limb vector (numpy; host-side)."""
-    return int_to_limbs(x % P * MONT_R % P)
+    """Canonical Fp int -> balanced Montgomery limb vector (host-side)."""
+    return balanced_limbs(x % P * MONT_R % P)
 
 
 def fp_decode(limbs):
@@ -83,17 +117,19 @@ def fp_decode(limbs):
 
 
 def fp_encode_batch(xs):
-    """list/array of ints [...] -> np.uint64[..., NLIMBS] in Montgomery form."""
-    return ints_to_limbs([int(x) % P * MONT_R % P for x in xs])
+    """list of ints [...] -> np.float32[..., NLIMBS], balanced Montgomery."""
+    return np.stack([balanced_limbs(int(x) % P * MONT_R % P) for x in xs])
 
 
 def fp_decode_batch(arr):
-    """np.uint64[..., NLIMBS] Montgomery -> list of canonical ints."""
+    """np.float32[..., NLIMBS] Montgomery -> list of canonical ints."""
     rinv = pow(MONT_R, -1, P)
-    a = np.asarray(arr, dtype=np.uint64)
+    a = np.asarray(arr)
     flat = a.reshape(-1, a.shape[-1])
     return [
-        sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(row)) * rinv % P
+        sum(int(round(float(v))) << (LIMB_BITS * i) for i, v in enumerate(row))
+        * rinv
+        % P
         for row in flat
     ]
 

@@ -21,7 +21,7 @@ from . import fp
 from . import tower as tw
 
 # Static bit schedule of |BLS_X|, msb first, leading bit dropped.
-_XBITS = jnp.array([int(b) for b in bin(-BLS_X)[2:]][1:], dtype=jnp.uint64)
+_XBITS = jnp.array([int(b) for b in bin(-BLS_X)[2:]][1:], dtype=jnp.int32)
 
 
 def _proj_double_step(T):

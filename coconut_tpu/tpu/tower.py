@@ -5,10 +5,16 @@ u^2 = -1, v^3 = xi = u+1, w^2 = v; same Karatsuba/complex formulas) so decoded
 results are bit-identical to the spec. Elements are tuples of Fp limb arrays,
 which makes every value a JAX pytree that flows through scan/jit unchanged.
 
-Additionally provides the sparse Fp12 x line multiplication for the Miller
-loop (`mul_line`): lines have only the (w^0, w^2, w^3) components (see
-`ops.pairing.line_to_fp12`), costing 15 Fp2 products instead of a full 54-mul
-Fp12 multiply.
+Compile-size/MXU design: every tower multiply bottoms out in ONE stacked
+base-field multiply (`fp.mul_stack`) — fp2_mul stacks its 3 Karatsuba
+products, fp6_mul stacks its 6 fp2 products (-> 18 base lanes), fp12_mul its
+3 fp6 products (-> 54 base lanes). One Fp12 multiply is therefore a single
+[.., 54, 48] MXU contraction instead of 54 separate multiplies: ~50x fewer
+HLO ops (XLA compile time) and far better systolic-array occupancy.
+
+Also provides the sparse Fp12 x line multiplication for the Miller loop
+(`mul_line`): lines have only the (w^0, w^2, w^3) components (see
+`ops.pairing.line_to_fp12`), 15 Fp2 products stacked into one multiply.
 """
 
 import jax
@@ -47,6 +53,35 @@ def decode_batch(tree):
     return fp_decode_batch(np.asarray(tree))
 
 
+# --- stack/unstack helpers ---------------------------------------------------
+
+
+def _bcast(elems):
+    return jnp.broadcast_arrays(*elems)
+
+
+def _stack2(elems):
+    """[(c0, c1), ...] fp2s -> stacked fp2 with a new [S] axis before limbs."""
+    return (
+        jnp.stack(_bcast([e[0] for e in elems]), axis=-2),
+        jnp.stack(_bcast([e[1] for e in elems]), axis=-2),
+    )
+
+
+def _unstack2(t, n):
+    return [(t[0][..., i, :], t[1][..., i, :]) for i in range(n)]
+
+
+def _stack6(elems):
+    """[(c0, c1, c2), ...] fp6s -> stacked fp6 (components are stacked fp2s)."""
+    return tuple(_stack2([e[i] for e in elems]) for i in range(3))
+
+
+def _unstack6(t, n):
+    parts = [_unstack2(t[i], n) for i in range(3)]
+    return [(parts[0][i], parts[1][i], parts[2][i]) for i in range(n)]
+
+
 # --- Fp2 --------------------------------------------------------------------
 
 
@@ -68,22 +103,26 @@ def fp2_neg(a):
 
 
 def fp2_mul(a, b):
-    t0 = fp.mul(a[0], b[0])
-    t1 = fp.mul(a[1], b[1])
-    t2 = fp.mul(fp.add(a[0], a[1]), fp.add(b[0], b[1]))
+    # Karatsuba: one stacked mul of [a0*b0, a1*b1, (a0+a1)(b0+b1)]
+    t0, t1, t2 = fp.mul_stack(
+        [a[0], a[1], fp.add(a[0], a[1])],
+        [b[0], b[1], fp.add(b[0], b[1])],
+    )
     return (fp.sub(t0, t1), fp.sub(fp.sub(t2, t0), t1))
 
 
 def fp2_sq(a):
-    # (a0+a1)(a0-a1), 2*a0*a1
-    return (
-        fp.mul(fp.add(a[0], a[1]), fp.sub(a[0], a[1])),
-        fp.mul_small(fp.mul(a[0], a[1]), 2),
+    # (a0+a1)(a0-a1), 2*a0*a1 — one stacked mul
+    t0, t1 = fp.mul_stack(
+        [fp.add(a[0], a[1]), a[0]],
+        [fp.sub(a[0], a[1]), a[1]],
     )
+    return (t0, fp.add(t1, t1))
 
 
 def fp2_mul_fp(a, s):
-    return (fp.mul(a[0], s), fp.mul(a[1], s))
+    t0, t1 = fp.mul_stack([a[0], a[1]], [s, s])
+    return (t0, t1)
 
 
 def fp2_mul_small(a, k):
@@ -100,9 +139,10 @@ def fp2_mul_xi(a):
 
 
 def fp2_inv(a):
-    norm = fp.add(fp.sq(a[0]), fp.sq(a[1]))
-    ninv = fp.inv(norm)
-    return (fp.mul(a[0], ninv), fp.neg(fp.mul(a[1], ninv)))
+    s0, s1 = fp.mul_stack([a[0], a[1]], [a[0], a[1]])
+    ninv = fp.inv(fp.add(s0, s1))
+    t0, t1 = fp.mul_stack([a[0], a[1]], [ninv, ninv])
+    return (t0, fp.neg(t1))
 
 
 def fp2_is_zero(a):
@@ -118,12 +158,15 @@ def fp2_select(mask, a, b):
 
 
 def fp2_zeros(shape=()):
-    z = jnp.zeros(tuple(shape) + (NLIMBS,), dtype=jnp.uint64)
+    z = jnp.zeros(tuple(shape) + (NLIMBS,), dtype=jnp.float32)
     return (z, z)
 
 
 def fp2_ones(shape=()):
-    return (fp.ones_mont(shape), jnp.zeros(tuple(shape) + (NLIMBS,), dtype=jnp.uint64))
+    return (
+        fp.ones_mont(shape),
+        jnp.zeros(tuple(shape) + (NLIMBS,), dtype=jnp.float32),
+    )
 
 
 # --- Fp6 --------------------------------------------------------------------
@@ -142,41 +185,23 @@ def fp6_neg(a):
 
 
 def fp6_mul(a, b):
+    """Toom-style 6-product fp6 multiply, all products in ONE stacked
+    fp2_mul (18 base lanes): t_i = a_i b_i, plus the three cross sums."""
     a0, a1, a2 = a
     b0, b1, b2 = b
-    t0 = fp2_mul(a0, b0)
-    t1 = fp2_mul(a1, b1)
-    t2 = fp2_mul(a2, b2)
-    c0 = fp2_add(
-        t0,
-        fp2_mul_xi(
-            fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)
+    prods = fp2_mul(
+        _stack2(
+            [a0, a1, a2, fp2_add(a1, a2), fp2_add(a0, a1), fp2_add(a0, a2)]
+        ),
+        _stack2(
+            [b0, b1, b2, fp2_add(b1, b2), fp2_add(b0, b1), fp2_add(b0, b2)]
         ),
     )
-    c1 = fp2_add(
-        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1),
-        fp2_mul_xi(t2),
-    )
-    c2 = fp2_add(
-        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1
-    )
+    t0, t1, t2, t12, t01, t02 = _unstack2(prods, 6)
+    c0 = fp2_add(t0, fp2_mul_xi(fp2_sub(fp2_sub(t12, t1), t2)))
+    c1 = fp2_add(fp2_sub(fp2_sub(t01, t0), t1), fp2_mul_xi(t2))
+    c2 = fp2_add(fp2_sub(fp2_sub(t02, t0), t2), t1)
     return (c0, c1, c2)
-
-
-def fp6_mul_by_01(a, s0, s1):
-    """a * (s0 + s1 v) — sparse, 6 Fp2 products."""
-    a0, a1, a2 = a
-    return (
-        fp2_add(fp2_mul(a0, s0), fp2_mul_xi(fp2_mul(a2, s1))),
-        fp2_add(fp2_mul(a1, s0), fp2_mul(a0, s1)),
-        fp2_add(fp2_mul(a2, s0), fp2_mul(a1, s1)),
-    )
-
-
-def fp6_mul_by_1(a, s1):
-    """a * (s1 v) — sparse, 3 Fp2 products."""
-    a0, a1, a2 = a
-    return (fp2_mul_xi(fp2_mul(a2, s1)), fp2_mul(a0, s1), fp2_mul(a1, s1))
 
 
 def fp6_mul_by_v(a):
@@ -185,14 +210,24 @@ def fp6_mul_by_v(a):
 
 def fp6_inv(a):
     a0, a1, a2 = a
-    c0 = fp2_sub(fp2_sq(a0), fp2_mul_xi(fp2_mul(a1, a2)))
-    c1 = fp2_sub(fp2_mul_xi(fp2_sq(a2)), fp2_mul(a0, a1))
-    c2 = fp2_sub(fp2_sq(a1), fp2_mul(a0, a2))
-    t = fp2_add(
-        fp2_mul_xi(fp2_add(fp2_mul(a2, c1), fp2_mul(a1, c2))), fp2_mul(a0, c0)
+    # six products in one stack: a0^2, a1*a2, a2^2, a0*a1, a1^2, a0*a2
+    prods = fp2_mul(
+        _stack2([a0, a1, a2, a0, a1, a0]), _stack2([a0, a2, a2, a1, a1, a2])
     )
+    s00, s12, s22, s01, s11, s02 = _unstack2(prods, 6)
+    c0 = fp2_sub(s00, fp2_mul_xi(s12))
+    c1 = fp2_sub(fp2_mul_xi(s22), s01)
+    c2 = fp2_sub(s11, s02)
+    # t = xi*(a2 c1 + a1 c2) + a0 c0 — three products in one stack
+    prods2 = fp2_mul(_stack2([a2, a1, a0]), _stack2([c1, c2, c0]))
+    u1, u2, u0 = _unstack2(prods2, 3)
+    t = fp2_add(fp2_mul_xi(fp2_add(u1, u2)), u0)
     tinv = fp2_inv(t)
-    return (fp2_mul(c0, tinv), fp2_mul(c1, tinv), fp2_mul(c2, tinv))
+    prods3 = fp2_mul(
+        _stack2([c0, c1, c2]), _stack2([tinv, tinv, tinv])
+    )
+    r0, r1, r2 = _unstack2(prods3, 3)
+    return (r0, r1, r2)
 
 
 def fp6_select(mask, a, b):
@@ -212,22 +247,29 @@ def fp6_ones(shape=()):
 
 
 def fp12_mul(a, b):
+    """Karatsuba over w: 3 fp6 products in ONE stacked fp6_mul (54 base
+    lanes -> a single MXU contraction)."""
     a0, a1 = a
     b0, b1 = b
-    t0 = fp6_mul(a0, b0)
-    t1 = fp6_mul(a1, b1)
+    prods = fp6_mul(
+        _stack6([a0, a1, fp6_add(a0, a1)]),
+        _stack6([b0, b1, fp6_add(b0, b1)]),
+    )
+    t0, t1, t2 = _unstack6(prods, 3)
     c0 = fp6_add(t0, fp6_mul_by_v(t1))
-    c1 = fp6_sub(fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(b0, b1)), t0), t1)
+    c1 = fp6_sub(fp6_sub(t2, t0), t1)
     return (c0, c1)
 
 
 def fp12_sq(a):
     a0, a1 = a
-    t = fp6_mul(a0, a1)
-    c0 = fp6_sub(
-        fp6_sub(fp6_mul(fp6_add(a0, a1), fp6_add(a0, fp6_mul_by_v(a1))), t),
-        fp6_mul_by_v(t),
+    # t = a0*a1 and s = (a0+a1)(a0 + v*a1) in one stacked fp6_mul
+    prods = fp6_mul(
+        _stack6([a0, fp6_add(a0, a1)]),
+        _stack6([a1, fp6_add(a0, fp6_mul_by_v(a1))]),
     )
+    t, s = _unstack6(prods, 2)
+    c0 = fp6_sub(fp6_sub(s, t), fp6_mul_by_v(t))
     c1 = fp6_add(t, t)
     return (c0, c1)
 
@@ -238,27 +280,54 @@ def fp12_conj(a):
 
 def fp12_inv(a):
     a0, a1 = a
-    t = fp6_sub(fp6_sq_(a0), fp6_mul_by_v(fp6_sq_(a1)))
+    prods = fp6_mul(_stack6([a0, a1]), _stack6([a0, a1]))
+    s0, s1 = _unstack6(prods, 2)
+    t = fp6_sub(s0, fp6_mul_by_v(s1))
     tinv = fp6_inv(t)
-    return (fp6_mul(a0, tinv), fp6_neg(fp6_mul(a1, tinv)))
-
-
-def fp6_sq_(a):
-    return fp6_mul(a, a)
+    prods2 = fp6_mul(_stack6([a0, a1]), _stack6([tinv, tinv]))
+    r0, r1 = _unstack6(prods2, 2)
+    return (r0, fp6_neg(r1))
 
 
 def mul_line(f, line):
     """f * (lA + lB w^2 + lC w^3) — the Miller-loop sparse product.
 
     The line element is s = (s0, s1) with s0 = (lA, lB, 0), s1 = (0, lC, 0)
-    (cf. ops.pairing.line_to_fp12). 15 Fp2 products total."""
+    (cf. ops.pairing.line_to_fp12). 15 Fp2 products in ONE stacked mul:
+    6 for f0*(lA,lB), 3 for f1*lC, 6 for (f0+f1)*(lA, lB+lC)."""
     lA, lB, lC = line
     f0, f1 = f
-    t0 = fp6_mul_by_01(f0, lA, lB)
-    t1 = fp6_mul_by_1(f1, lC)
+    g = fp6_add(f0, f1)
+    lBC = fp2_add(lB, lC)
+    lhs = _stack2(
+        [
+            f0[0], f0[2], f0[1], f0[0], f0[2], f0[1],  # mul_by_01(f0, lA, lB)
+            f1[2], f1[0], f1[1],                        # mul_by_1(f1, lC)
+            g[0], g[2], g[1], g[0], g[2], g[1],         # mul_by_01(g, lA, lBC)
+        ]
+    )
+    rhs = _stack2(
+        [
+            lA, lB, lA, lB, lA, lB,
+            lC, lC, lC,
+            lA, lBC, lA, lBC, lA, lBC,
+        ]
+    )
+    p = _unstack2(fp2_mul(lhs, rhs), 15)
+    # mul_by_01 structure: c0 = a0*s0 + xi*(a2*s1); c1 = a1*s0 + a0*s1;
+    # c2 = a2*s0 + a1*s1 — regroup the products accordingly:
+    t0 = (
+        fp2_add(p[0], fp2_mul_xi(p[1])),
+        fp2_add(p[2], p[3]),
+        fp2_add(p[4], p[5]),
+    )
+    t1 = (fp2_mul_xi(p[6]), p[7], p[8])
+    mixed = (
+        fp2_add(p[9], fp2_mul_xi(p[10])),
+        fp2_add(p[11], p[12]),
+        fp2_add(p[13], p[14]),
+    )
     c0 = fp6_add(t0, fp6_mul_by_v(t1))
-    # (f0 + f1) * (lA, lB + lC, 0)
-    mixed = fp6_mul_by_01(fp6_add(f0, f1), lA, fp2_add(lB, lC))
     c1 = fp6_sub(fp6_sub(mixed, t0), t1)
     return (c0, c1)
 
@@ -270,28 +339,30 @@ _G2C = [fp2_encode_const(c) for c in F._GAMMA2]
 
 def fp12_frobenius(a):
     a0, a1 = a
-    b0 = (
-        fp2_conj(a0[0]),
-        fp2_mul(fp2_conj(a0[1]), _G1C[2]),
-        fp2_mul(fp2_conj(a0[2]), _G1C[4]),
+    prods = fp2_mul(
+        _stack2(
+            [
+                fp2_conj(a0[1]),
+                fp2_conj(a0[2]),
+                fp2_conj(a1[0]),
+                fp2_conj(a1[1]),
+                fp2_conj(a1[2]),
+            ]
+        ),
+        _stack2([_G1C[2], _G1C[4], _G1C[1], _G1C[3], _G1C[5]]),
     )
-    b1 = (
-        fp2_mul(fp2_conj(a1[0]), _G1C[1]),
-        fp2_mul(fp2_conj(a1[1]), _G1C[3]),
-        fp2_mul(fp2_conj(a1[2]), _G1C[5]),
-    )
-    return (b0, b1)
+    m01, m02, m10, m11, m12 = _unstack2(prods, 5)
+    return ((fp2_conj(a0[0]), m01, m02), (m10, m11, m12))
 
 
 def fp12_frobenius2(a):
     a0, a1 = a
-    b0 = (a0[0], fp2_mul(a0[1], _G2C[2]), fp2_mul(a0[2], _G2C[4]))
-    b1 = (
-        fp2_mul(a1[0], _G2C[1]),
-        fp2_mul(a1[1], _G2C[3]),
-        fp2_mul(a1[2], _G2C[5]),
+    prods = fp2_mul(
+        _stack2([a0[1], a0[2], a1[0], a1[1], a1[2]]),
+        _stack2([_G2C[2], _G2C[4], _G2C[1], _G2C[3], _G2C[5]]),
     )
-    return (b0, b1)
+    m01, m02, m10, m11, m12 = _unstack2(prods, 5)
+    return ((a0[0], m01, m02), (m10, m11, m12))
 
 
 def fp12_select(mask, a, b):
@@ -303,10 +374,10 @@ def fp12_ones(shape=()):
 
 
 def fp12_is_one(a):
-    """Componentwise equality with the Montgomery one."""
-    one = fp12_ones(a[0][0][0].shape[:-1])
-    bits = None
-    for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(one)):
-        b = jnp.all(x == y, axis=-1)
-        bits = b if bits is None else (bits & b)
+    """Exact componentwise test against the Montgomery one (values are
+    redundant — fp.eq/is_zero do the exact mod-p comparison)."""
+    comps = jax.tree_util.tree_leaves(a)  # 12 Fp components, c0.c0.c0 first
+    bits = fp.eq(comps[0], fp.ones_mont(comps[0].shape[:-1]))
+    for x in comps[1:]:
+        bits = bits & fp.is_zero(x)
     return bits

@@ -1,0 +1,1035 @@
+// ccbls — native BLS12-381 core for the coconut_tpu framework.
+//
+// SURVEY.md §7 stage 1: the from-scratch equivalent of the reference's
+// amcl/amcl_wrapper curve layer (reference Cargo.toml:16-19; call sites
+// signature.rs:157,424-428,465,513,521 and the pairing check reached via
+// signature.rs:472-478). Design follows the framework's own Python spec
+// (coconut_tpu/ops/fields.py, curve.py, pairing.py) — results are
+// bit-identical to the spec on canonical (affine / boolean) outputs, which
+// tests/test_backends.py enforces differentially for every backend.
+//
+// Layout of the file: Fp (6x64 Montgomery) -> Fp2/Fp6/Fp12 tower -> G1/G2
+// Jacobian points -> shared-base windowed MSM (var-time, public data, and a
+// fixed-window masked-lookup variant for secret scalars) -> projective
+// Miller loop + final exponentiation -> batch C ABI.
+//
+// Wire codec (the C ABI boundary): Fp = 48 bytes little-endian canonical;
+// Fp2 = c0 || c1; affine points = x || y with the point at infinity encoded
+// as all-zero bytes (not a curve point: 0^3 + 4 != 0); scalars = 32 bytes
+// little-endian canonical Fr.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+using u64 = uint64_t;
+using u128 = unsigned __int128;
+
+// ---------------------------------------------------------------------------
+// Fp: base field, 6x64-bit limbs, Montgomery domain (R = 2^384)
+// ---------------------------------------------------------------------------
+
+static const u64 PL[6] = {
+    0xb9feffffffffaaabULL, 0x1eabfffeb153ffffULL, 0x6730d2a0f6b0f624ULL,
+    0x64774b84f38512bfULL, 0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL};
+// -p^{-1} mod 2^64
+static const u64 P_N0 = 0x89f3fffcfffcfffdULL;
+// R^2 mod p (enters the Montgomery domain)
+static const u64 RR[6] = {
+    0xf4df1f341c341746ULL, 0x0a76e6a609d104f1ULL, 0x8de5476c4c95b6d5ULL,
+    0x67eb88a9939d83c0ULL, 0x9a793e85b519952dULL, 0x11988fe592cae3aaULL};
+
+struct Fp {
+  u64 v[6];
+};
+
+static inline bool fp_is_zero_raw(const Fp &a) {
+  u64 t = 0;
+  for (int i = 0; i < 6; i++) t |= a.v[i];
+  return t == 0;
+}
+
+static inline bool fp_eq_raw(const Fp &a, const Fp &b) {
+  u64 t = 0;
+  for (int i = 0; i < 6; i++) t |= a.v[i] ^ b.v[i];
+  return t == 0;
+}
+
+static inline int fp_cmp_p(const Fp &a) {  // a ? p  -> -1,0,1
+  for (int i = 5; i >= 0; i--) {
+    if (a.v[i] < PL[i]) return -1;
+    if (a.v[i] > PL[i]) return 1;
+  }
+  return 0;
+}
+
+static inline void fp_sub_p(Fp &a) {
+  u128 borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)a.v[i] - PL[i] - borrow;
+    a.v[i] = (u64)d;
+    borrow = (d >> 64) & 1;
+  }
+}
+
+static inline Fp fp_add(const Fp &a, const Fp &b) {
+  Fp r;
+  u128 carry = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 s = (u128)a.v[i] + b.v[i] + carry;
+    r.v[i] = (u64)s;
+    carry = s >> 64;
+  }
+  if (carry || fp_cmp_p(r) >= 0) fp_sub_p(r);
+  return r;
+}
+
+static inline Fp fp_sub(const Fp &a, const Fp &b) {
+  Fp r;
+  u128 borrow = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 d = (u128)a.v[i] - b.v[i] - borrow;
+    r.v[i] = (u64)d;
+    borrow = (d >> 64) & 1;
+  }
+  if (borrow) {
+    u128 carry = 0;
+    for (int i = 0; i < 6; i++) {
+      u128 s = (u128)r.v[i] + PL[i] + carry;
+      r.v[i] = (u64)s;
+      carry = s >> 64;
+    }
+  }
+  return r;
+}
+
+static inline Fp fp_neg(const Fp &a) {
+  if (fp_is_zero_raw(a)) return a;
+  Fp p;
+  memcpy(p.v, PL, sizeof(PL));
+  return fp_sub(p, a);
+}
+
+static inline Fp fp_dbl(const Fp &a) { return fp_add(a, a); }
+
+// CIOS Montgomery multiplication: r = a*b*R^{-1} mod p
+static inline Fp fp_mul(const Fp &a, const Fp &b) {
+  u64 t[8] = {0};
+  for (int i = 0; i < 6; i++) {
+    u128 carry = 0;
+    for (int j = 0; j < 6; j++) {
+      u128 s = (u128)t[j] + (u128)a.v[i] * b.v[j] + carry;
+      t[j] = (u64)s;
+      carry = s >> 64;
+    }
+    u128 s = (u128)t[6] + carry;
+    t[6] = (u64)s;
+    t[7] = (u64)(s >> 64);
+
+    u64 m = t[0] * P_N0;
+    carry = ((u128)t[0] + (u128)m * PL[0]) >> 64;
+    for (int j = 1; j < 6; j++) {
+      u128 s2 = (u128)t[j] + (u128)m * PL[j] + carry;
+      t[j - 1] = (u64)s2;
+      carry = s2 >> 64;
+    }
+    s = (u128)t[6] + carry;
+    t[5] = (u64)s;
+    t[6] = t[7] + (u64)(s >> 64);
+    t[7] = 0;
+  }
+  Fp r;
+  memcpy(r.v, t, 48);
+  if (t[6] || fp_cmp_p(r) >= 0) fp_sub_p(r);
+  return r;
+}
+
+static inline Fp fp_sq(const Fp &a) { return fp_mul(a, a); }
+
+static inline Fp fp_mul_small(const Fp &a, u64 k) {
+  Fp r = {{0, 0, 0, 0, 0, 0}};
+  Fp base = a;
+  while (k) {
+    if (k & 1) r = fp_add(r, base);
+    k >>= 1;
+    if (k) base = fp_dbl(base);
+  }
+  return r;
+}
+
+static const Fp FP_ZERO = {{0, 0, 0, 0, 0, 0}};
+
+static Fp FP_ONE;  // R mod p, set in init
+
+static Fp fp_from_le(const uint8_t *b) {  // canonical LE bytes -> Montgomery
+  Fp a;
+  for (int i = 0; i < 6; i++) {
+    u64 w = 0;
+    for (int j = 0; j < 8; j++) w |= (u64)b[i * 8 + j] << (8 * j);
+    a.v[i] = w;
+  }
+  Fp rr;
+  memcpy(rr.v, RR, 48);
+  return fp_mul(a, rr);
+}
+
+static void fp_to_le(const Fp &a, uint8_t *b) {  // Montgomery -> canonical LE
+  Fp one = {{1, 0, 0, 0, 0, 0}};
+  Fp c = fp_mul(a, one);  // divides by R
+  for (int i = 0; i < 6; i++)
+    for (int j = 0; j < 8; j++) b[i * 8 + j] = (uint8_t)(c.v[i] >> (8 * j));
+}
+
+// a^e for big-endian limb exponent (var-time; used for inversion & init pows)
+static Fp fp_pow(const Fp &a, const u64 *e, int nlimbs) {
+  Fp r = FP_ONE;
+  bool started = false;
+  for (int i = nlimbs - 1; i >= 0; i--) {
+    for (int bit = 63; bit >= 0; bit--) {
+      if (started) r = fp_sq(r);
+      if ((e[i] >> bit) & 1) {
+        if (!started) {
+          r = a;
+          started = true;
+        } else {
+          r = fp_mul(r, a);
+        }
+      }
+    }
+  }
+  return r;
+}
+
+static Fp fp_inv(const Fp &a) {  // a^{p-2}
+  u64 e[6];
+  memcpy(e, PL, 48);
+  u128 d = (u128)e[0] - 2;
+  e[0] = (u64)d;  // p-2 (no borrow: p odd, > 2)
+  return fp_pow(a, e, 6);
+}
+
+// ---------------------------------------------------------------------------
+// Fp2 = Fp[u]/(u^2+1); Fp6 = Fp2[v]/(v^3 - (u+1)); Fp12 = Fp6[w]/(w^2 - v)
+// (the spec's tower, ops/fields.py)
+// ---------------------------------------------------------------------------
+
+struct Fp2 {
+  Fp c0, c1;
+};
+
+static inline Fp2 fp2_add(const Fp2 &a, const Fp2 &b) {
+  return {fp_add(a.c0, b.c0), fp_add(a.c1, b.c1)};
+}
+static inline Fp2 fp2_sub(const Fp2 &a, const Fp2 &b) {
+  return {fp_sub(a.c0, b.c0), fp_sub(a.c1, b.c1)};
+}
+static inline Fp2 fp2_neg(const Fp2 &a) { return {fp_neg(a.c0), fp_neg(a.c1)}; }
+static inline Fp2 fp2_conj(const Fp2 &a) { return {a.c0, fp_neg(a.c1)}; }
+
+static inline Fp2 fp2_mul(const Fp2 &a, const Fp2 &b) {
+  Fp t0 = fp_mul(a.c0, b.c0);
+  Fp t1 = fp_mul(a.c1, b.c1);
+  Fp t2 = fp_mul(fp_add(a.c0, a.c1), fp_add(b.c0, b.c1));
+  return {fp_sub(t0, t1), fp_sub(fp_sub(t2, t0), t1)};
+}
+
+static inline Fp2 fp2_sq(const Fp2 &a) {
+  return {fp_mul(fp_add(a.c0, a.c1), fp_sub(a.c0, a.c1)),
+          fp_dbl(fp_mul(a.c0, a.c1))};
+}
+
+static inline Fp2 fp2_mul_fp(const Fp2 &a, const Fp &s) {
+  return {fp_mul(a.c0, s), fp_mul(a.c1, s)};
+}
+
+static inline Fp2 fp2_mul_small(const Fp2 &a, u64 k) {
+  return {fp_mul_small(a.c0, k), fp_mul_small(a.c1, k)};
+}
+
+static inline Fp2 fp2_mul_xi(const Fp2 &a) {  // * (u+1)
+  return {fp_sub(a.c0, a.c1), fp_add(a.c0, a.c1)};
+}
+
+static inline Fp2 fp2_inv(const Fp2 &a) {
+  Fp norm = fp_add(fp_sq(a.c0), fp_sq(a.c1));
+  Fp ni = fp_inv(norm);
+  return {fp_mul(a.c0, ni), fp_neg(fp_mul(a.c1, ni))};
+}
+
+static inline bool fp2_is_zero(const Fp2 &a) {
+  return fp_is_zero_raw(a.c0) && fp_is_zero_raw(a.c1);
+}
+static inline bool fp2_eq(const Fp2 &a, const Fp2 &b) {
+  return fp_eq_raw(a.c0, b.c0) && fp_eq_raw(a.c1, b.c1);
+}
+
+static const Fp2 FP2_ZERO = {FP_ZERO, FP_ZERO};
+static Fp2 FP2_ONE;  // set in init
+
+static Fp2 fp2_pow(const Fp2 &a, const u64 *e, int nlimbs) {
+  Fp2 r = FP2_ONE;
+  bool started = false;
+  for (int i = nlimbs - 1; i >= 0; i--)
+    for (int bit = 63; bit >= 0; bit--) {
+      if (started) r = fp2_sq(r);
+      if ((e[i] >> bit) & 1) {
+        if (!started) {
+          r = a;
+          started = true;
+        } else {
+          r = fp2_mul(r, a);
+        }
+      }
+    }
+  return r;
+}
+
+struct Fp6 {
+  Fp2 c0, c1, c2;
+};
+
+static inline Fp6 fp6_add(const Fp6 &a, const Fp6 &b) {
+  return {fp2_add(a.c0, b.c0), fp2_add(a.c1, b.c1), fp2_add(a.c2, b.c2)};
+}
+static inline Fp6 fp6_sub(const Fp6 &a, const Fp6 &b) {
+  return {fp2_sub(a.c0, b.c0), fp2_sub(a.c1, b.c1), fp2_sub(a.c2, b.c2)};
+}
+static inline Fp6 fp6_neg(const Fp6 &a) {
+  return {fp2_neg(a.c0), fp2_neg(a.c1), fp2_neg(a.c2)};
+}
+
+static inline Fp6 fp6_mul(const Fp6 &a, const Fp6 &b) {
+  Fp2 t0 = fp2_mul(a.c0, b.c0);
+  Fp2 t1 = fp2_mul(a.c1, b.c1);
+  Fp2 t2 = fp2_mul(a.c2, b.c2);
+  Fp2 c0 = fp2_add(
+      t0, fp2_mul_xi(fp2_sub(
+              fp2_sub(fp2_mul(fp2_add(a.c1, a.c2), fp2_add(b.c1, b.c2)), t1),
+              t2)));
+  Fp2 c1 = fp2_add(
+      fp2_sub(fp2_sub(fp2_mul(fp2_add(a.c0, a.c1), fp2_add(b.c0, b.c1)), t0),
+              t1),
+      fp2_mul_xi(t2));
+  Fp2 c2 = fp2_add(
+      fp2_sub(fp2_sub(fp2_mul(fp2_add(a.c0, a.c2), fp2_add(b.c0, b.c2)), t0),
+              t2),
+      t1);
+  return {c0, c1, c2};
+}
+
+static inline Fp6 fp6_mul_by_01(const Fp6 &a, const Fp2 &s0, const Fp2 &s1) {
+  return {fp2_add(fp2_mul(a.c0, s0), fp2_mul_xi(fp2_mul(a.c2, s1))),
+          fp2_add(fp2_mul(a.c1, s0), fp2_mul(a.c0, s1)),
+          fp2_add(fp2_mul(a.c2, s0), fp2_mul(a.c1, s1))};
+}
+
+static inline Fp6 fp6_mul_by_1(const Fp6 &a, const Fp2 &s1) {
+  return {fp2_mul_xi(fp2_mul(a.c2, s1)), fp2_mul(a.c0, s1), fp2_mul(a.c1, s1)};
+}
+
+static inline Fp6 fp6_mul_by_v(const Fp6 &a) {
+  return {fp2_mul_xi(a.c2), a.c0, a.c1};
+}
+
+static inline Fp6 fp6_inv(const Fp6 &a) {
+  Fp2 c0 = fp2_sub(fp2_sq(a.c0), fp2_mul_xi(fp2_mul(a.c1, a.c2)));
+  Fp2 c1 = fp2_sub(fp2_mul_xi(fp2_sq(a.c2)), fp2_mul(a.c0, a.c1));
+  Fp2 c2 = fp2_sub(fp2_sq(a.c1), fp2_mul(a.c0, a.c2));
+  Fp2 t = fp2_add(fp2_mul_xi(fp2_add(fp2_mul(a.c2, c1), fp2_mul(a.c1, c2))),
+                  fp2_mul(a.c0, c0));
+  Fp2 ti = fp2_inv(t);
+  return {fp2_mul(c0, ti), fp2_mul(c1, ti), fp2_mul(c2, ti)};
+}
+
+static const Fp6 FP6_ZERO = {FP2_ZERO, FP2_ZERO, FP2_ZERO};
+static Fp6 FP6_ONE;
+
+struct Fp12 {
+  Fp6 c0, c1;
+};
+
+static Fp12 FP12_ONE;
+
+static inline Fp12 fp12_mul(const Fp12 &a, const Fp12 &b) {
+  Fp6 t0 = fp6_mul(a.c0, b.c0);
+  Fp6 t1 = fp6_mul(a.c1, b.c1);
+  Fp6 c0 = fp6_add(t0, fp6_mul_by_v(t1));
+  Fp6 c1 =
+      fp6_sub(fp6_sub(fp6_mul(fp6_add(a.c0, a.c1), fp6_add(b.c0, b.c1)), t0),
+              t1);
+  return {c0, c1};
+}
+
+static inline Fp12 fp12_sq(const Fp12 &a) {
+  Fp6 t = fp6_mul(a.c0, a.c1);
+  Fp6 c0 = fp6_sub(
+      fp6_sub(fp6_mul(fp6_add(a.c0, a.c1), fp6_add(a.c0, fp6_mul_by_v(a.c1))),
+              t),
+      fp6_mul_by_v(t));
+  Fp6 c1 = fp6_add(t, t);
+  return {c0, c1};
+}
+
+static inline Fp12 fp12_conj(const Fp12 &a) { return {a.c0, fp6_neg(a.c1)}; }
+
+static inline Fp12 fp12_inv(const Fp12 &a) {
+  Fp6 t = fp6_sub(fp6_mul(a.c0, a.c0), fp6_mul_by_v(fp6_mul(a.c1, a.c1)));
+  Fp6 ti = fp6_inv(t);
+  return {fp6_mul(a.c0, ti), fp6_neg(fp6_mul(a.c1, ti))};
+}
+
+// f * (lA + lB w^2 + lC w^3): the Miller-loop sparse product
+// (spec ops/pairing.py line_to_fp12 + tower mul_line)
+static inline Fp12 fp12_mul_line(const Fp12 &f, const Fp2 &lA, const Fp2 &lB,
+                                 const Fp2 &lC) {
+  Fp6 t0 = fp6_mul_by_01(f.c0, lA, lB);
+  Fp6 t1 = fp6_mul_by_1(f.c1, lC);
+  Fp6 c0 = fp6_add(t0, fp6_mul_by_v(t1));
+  Fp6 mixed = fp6_mul_by_01(fp6_add(f.c0, f.c1), lA, fp2_add(lB, lC));
+  Fp6 c1 = fp6_sub(fp6_sub(mixed, t0), t1);
+  return {c0, c1};
+}
+
+// Frobenius coefficients (computed at init: gamma1[i] = xi^{i(p-1)/6},
+// gamma2[i] = gamma1[i] * conj(gamma1[i]), mirroring the spec's
+// ops/fields.py _GAMMA1/_GAMMA2)
+static Fp2 G1C[6];
+static Fp2 G2C[6];
+
+static inline Fp12 fp12_frobenius(const Fp12 &a) {
+  Fp12 r;
+  r.c0.c0 = fp2_conj(a.c0.c0);
+  r.c0.c1 = fp2_mul(fp2_conj(a.c0.c1), G1C[2]);
+  r.c0.c2 = fp2_mul(fp2_conj(a.c0.c2), G1C[4]);
+  r.c1.c0 = fp2_mul(fp2_conj(a.c1.c0), G1C[1]);
+  r.c1.c1 = fp2_mul(fp2_conj(a.c1.c1), G1C[3]);
+  r.c1.c2 = fp2_mul(fp2_conj(a.c1.c2), G1C[5]);
+  return r;
+}
+
+static inline Fp12 fp12_frobenius2(const Fp12 &a) {
+  Fp12 r;
+  r.c0.c0 = a.c0.c0;
+  r.c0.c1 = fp2_mul(a.c0.c1, G2C[2]);
+  r.c0.c2 = fp2_mul(a.c0.c2, G2C[4]);
+  r.c1.c0 = fp2_mul(a.c1.c0, G2C[1]);
+  r.c1.c1 = fp2_mul(a.c1.c1, G2C[3]);
+  r.c1.c2 = fp2_mul(a.c1.c2, G2C[5]);
+  return r;
+}
+
+static inline bool fp2_is_one(const Fp2 &a) {
+  return fp_eq_raw(a.c0, FP_ONE) && fp_is_zero_raw(a.c1);
+}
+
+static inline bool fp12_eq_one(const Fp12 &a) {
+  return fp2_is_one(a.c0.c0) && fp2_is_zero(a.c0.c1) && fp2_is_zero(a.c0.c2) &&
+         fp2_is_zero(a.c1.c0) && fp2_is_zero(a.c1.c1) && fp2_is_zero(a.c1.c2);
+}
+
+// ---------------------------------------------------------------------------
+// Curve points (Jacobian), generic over the coordinate field
+// ---------------------------------------------------------------------------
+
+template <typename F>
+struct FieldOps;  // add/sub/mul/sq/neg/dbl/small/inv/zero/one/is_zero/eq
+
+template <>
+struct FieldOps<Fp> {
+  static Fp add(const Fp &a, const Fp &b) { return fp_add(a, b); }
+  static Fp sub(const Fp &a, const Fp &b) { return fp_sub(a, b); }
+  static Fp mul(const Fp &a, const Fp &b) { return fp_mul(a, b); }
+  static Fp sq(const Fp &a) { return fp_sq(a); }
+  static Fp neg(const Fp &a) { return fp_neg(a); }
+  static Fp small(const Fp &a, u64 k) { return fp_mul_small(a, k); }
+  static Fp inv(const Fp &a) { return fp_inv(a); }
+  static Fp zero() { return FP_ZERO; }
+  static Fp one() { return FP_ONE; }
+  static bool is_zero(const Fp &a) { return fp_is_zero_raw(a); }
+  static bool eq(const Fp &a, const Fp &b) { return fp_eq_raw(a, b); }
+};
+
+template <>
+struct FieldOps<Fp2> {
+  static Fp2 add(const Fp2 &a, const Fp2 &b) { return fp2_add(a, b); }
+  static Fp2 sub(const Fp2 &a, const Fp2 &b) { return fp2_sub(a, b); }
+  static Fp2 mul(const Fp2 &a, const Fp2 &b) { return fp2_mul(a, b); }
+  static Fp2 sq(const Fp2 &a) { return fp2_sq(a); }
+  static Fp2 neg(const Fp2 &a) { return fp2_neg(a); }
+  static Fp2 small(const Fp2 &a, u64 k) { return fp2_mul_small(a, k); }
+  static Fp2 inv(const Fp2 &a) { return fp2_inv(a); }
+  static Fp2 zero() { return FP2_ZERO; }
+  static Fp2 one() { return FP2_ONE; }
+  static bool is_zero(const Fp2 &a) { return fp2_is_zero(a); }
+  static bool eq(const Fp2 &a, const Fp2 &b) { return fp2_eq(a, b); }
+};
+
+template <typename F>
+struct Jac {
+  F X, Y, Z;
+};
+
+template <typename F>
+static inline bool jac_is_inf(const Jac<F> &p) {
+  return FieldOps<F>::is_zero(p.Z);
+}
+
+template <typename F>
+static inline Jac<F> jac_inf() {
+  return {FieldOps<F>::one(), FieldOps<F>::one(), FieldOps<F>::zero()};
+}
+
+// Same formulas as the spec (ops/curve.py:95-113)
+template <typename F>
+static Jac<F> jac_double(const Jac<F> &p) {
+  using O = FieldOps<F>;
+  if (O::is_zero(p.Z) || O::is_zero(p.Y)) return jac_inf<F>();
+  F A = O::sq(p.X);
+  F B = O::sq(p.Y);
+  F C = O::sq(B);
+  F D = O::sub(O::sub(O::sq(O::add(p.X, B)), A), C);
+  D = O::add(D, D);
+  F E = O::small(A, 3);
+  F Fv = O::sq(E);
+  F X3 = O::sub(Fv, O::add(D, D));
+  F Y3 = O::sub(O::mul(E, O::sub(D, X3)), O::small(C, 8));
+  F Z3 = O::mul(O::add(p.Y, p.Y), p.Z);
+  return {X3, Y3, Z3};
+}
+
+// Same formulas as the spec (ops/curve.py:115-143)
+template <typename F>
+static Jac<F> jac_add(const Jac<F> &p, const Jac<F> &q) {
+  using O = FieldOps<F>;
+  if (O::is_zero(p.Z)) return q;
+  if (O::is_zero(q.Z)) return p;
+  F Z1Z1 = O::sq(p.Z);
+  F Z2Z2 = O::sq(q.Z);
+  F U1 = O::mul(p.X, Z2Z2);
+  F U2 = O::mul(q.X, Z1Z1);
+  F S1 = O::mul(p.Y, O::mul(q.Z, Z2Z2));
+  F S2 = O::mul(q.Y, O::mul(p.Z, Z1Z1));
+  F H = O::sub(U2, U1);
+  F rr = O::sub(S2, S1);
+  if (O::is_zero(H)) {
+    if (O::is_zero(rr)) return jac_double(p);
+    return jac_inf<F>();
+  }
+  rr = O::add(rr, rr);
+  F I = O::sq(O::add(H, H));
+  F J = O::mul(H, I);
+  F V = O::mul(U1, I);
+  F X3 = O::sub(O::sub(O::sq(rr), J), O::add(V, V));
+  F S1J = O::mul(S1, J);
+  F Y3 = O::sub(O::mul(rr, O::sub(V, X3)), O::add(S1J, S1J));
+  F Z3 = O::mul(O::mul(p.Z, q.Z), H);
+  Z3 = O::add(Z3, Z3);
+  return {X3, Y3, Z3};
+}
+
+// Mixed addition q affine (Z=1) — saves ~4 muls in the MSM inner loop
+template <typename F>
+static Jac<F> jac_add_affine(const Jac<F> &p, const F &qx, const F &qy,
+                             bool q_inf) {
+  using O = FieldOps<F>;
+  if (q_inf) return p;
+  if (O::is_zero(p.Z)) return {qx, qy, O::one()};
+  F Z1Z1 = O::sq(p.Z);
+  F U2 = O::mul(qx, Z1Z1);
+  F S2 = O::mul(qy, O::mul(p.Z, Z1Z1));
+  F H = O::sub(U2, p.X);
+  F rr = O::sub(S2, p.Y);
+  if (O::is_zero(H)) {
+    if (O::is_zero(rr)) return jac_double(p);
+    return jac_inf<F>();
+  }
+  rr = O::add(rr, rr);
+  F I = O::sq(O::add(H, H));
+  F J = O::mul(H, I);
+  F V = O::mul(p.X, I);
+  F X3 = O::sub(O::sub(O::sq(rr), J), O::add(V, V));
+  F S1J = O::mul(p.Y, J);
+  S1J = O::add(S1J, S1J);
+  F Y3 = O::sub(O::mul(rr, O::sub(V, X3)), S1J);
+  F Z3 = O::mul(p.Z, H);
+  Z3 = O::add(Z3, Z3);
+  return {X3, Y3, Z3};
+}
+
+template <typename F>
+static void jac_to_affine(const Jac<F> &p, F &x, F &y, bool &inf) {
+  using O = FieldOps<F>;
+  if (O::is_zero(p.Z)) {
+    inf = true;
+    x = O::zero();
+    y = O::zero();
+    return;
+  }
+  inf = false;
+  F zi = O::inv(p.Z);
+  F zi2 = O::sq(zi);
+  x = O::mul(p.X, zi2);
+  y = O::mul(p.Y, O::mul(zi2, zi));
+}
+
+// ---------------------------------------------------------------------------
+// Shared-base windowed MSM (matches the TPU kernel's schedule: 4-bit
+// windows msb-first over 256-bit scalars, per-base 16-entry tables)
+// ---------------------------------------------------------------------------
+
+struct Scalar {
+  u64 v[4];
+};  // 256-bit LE canonical
+
+static inline unsigned scalar_window(const Scalar &s, int w) {
+  // w = window index from msb: bits [252-4w .. 255-4w]
+  int lo = 252 - 4 * w;
+  return (unsigned)((s.v[lo / 64] >> (lo % 64)) & 0xf);
+}
+
+template <typename F>
+static void msm_tables(const F *bx, const F *by, const bool *binf, int k,
+                       std::vector<Jac<F>> &tables) {
+  tables.assign((size_t)k * 16, jac_inf<F>());
+  for (int j = 0; j < k; j++) {
+    Jac<F> *row = &tables[(size_t)j * 16];
+    row[0] = jac_inf<F>();
+    if (binf[j]) {
+      for (int d = 1; d < 16; d++) row[d] = jac_inf<F>();
+      continue;
+    }
+    Jac<F> base = {bx[j], by[j], FieldOps<F>::one()};
+    row[1] = base;
+    for (int d = 2; d < 16; d++) row[d] = jac_add(row[d - 1], base);
+  }
+}
+
+// One batch row: acc = sum_j s[j] * base[j], var-time (public data — the
+// verify-side split the reference also makes, signature.rs:465 vs :513)
+template <typename F>
+static Jac<F> msm_row(const std::vector<Jac<F>> &tables, const Scalar *s,
+                      int k) {
+  Jac<F> acc = jac_inf<F>();
+  for (int w = 0; w < 64; w++) {
+    if (w) {
+      acc = jac_double(acc);
+      acc = jac_double(acc);
+      acc = jac_double(acc);
+      acc = jac_double(acc);
+    }
+    for (int j = 0; j < k; j++) {
+      unsigned d = scalar_window(s[j], w);
+      if (d) acc = jac_add(acc, tables[(size_t)j * 16 + d]);
+    }
+  }
+  return acc;
+}
+
+// Fixed-window masked-lookup variant for secret scalars (issuance side:
+// const-time MSM call sites signature.rs:157,424-428). Every table entry is
+// read and every add executed; selection is by byte masks.
+template <typename F>
+static Jac<F> msm_row_ct(const std::vector<Jac<F>> &tables, const Scalar *s,
+                         int k) {
+  using O = FieldOps<F>;
+  Jac<F> acc = jac_inf<F>();
+  for (int w = 0; w < 64; w++) {
+    if (w) {
+      acc = jac_double(acc);
+      acc = jac_double(acc);
+      acc = jac_double(acc);
+      acc = jac_double(acc);
+    }
+    for (int j = 0; j < k; j++) {
+      unsigned d = scalar_window(s[j], w);
+      // masked gather of tables[j][d]
+      Jac<F> e = jac_inf<F>();
+      const u64 *src0 = (const u64 *)&tables[(size_t)j * 16];
+      u64 *dst = (u64 *)&e;
+      size_t words = sizeof(Jac<F>) / 8;
+      for (unsigned t = 0; t < 16; t++) {
+        u64 mask = (u64)0 - (u64)(t == d);
+        const u64 *src = src0 + (size_t)t * words;
+        for (size_t q = 0; q < words; q++) dst[q] = (dst[q] & ~mask) | (src[q] & mask);
+      }
+      acc = jac_add(acc, e);  // NOTE: add itself branches on edge cases;
+      // full constant-time completeness is documented as a caveat in
+      // coconut_tpu/native.py (the verify hot path never uses this variant).
+    }
+  }
+  return acc;
+}
+
+// ---------------------------------------------------------------------------
+// Pairing: projective Miller loop + final exponentiation
+// (structure mirrors the spec ops/pairing.py miller_loop_projective /
+// final_exp_chain and the TPU kernel tpu/pairing.py — same line coeffs,
+// same x-power chain)
+// ---------------------------------------------------------------------------
+
+static const u64 BLS_X_ABS = 0xd201000000010000ULL;  // |x|, x < 0
+
+struct ProjT {
+  Fp2 X, Y, Z;
+};
+
+static inline void proj_double_step(ProjT &T, Fp2 &lA, Fp2 &lB, Fp2 &lC) {
+  Fp2 A = fp2_sq(T.X);
+  Fp2 B = fp2_sq(T.Y);
+  Fp2 C = fp2_sq(T.Z);
+  Fp2 D = fp2_mul(fp2_mul(T.X, B), T.Z);
+  Fp2 Fv = fp2_sub(fp2_mul_small(fp2_sq(A), 9), fp2_mul_small(D, 8));
+  Fp2 YZ = fp2_mul(T.Y, T.Z);
+  Fp2 X3 = fp2_mul(fp2_mul_small(YZ, 2), Fv);
+  Fp2 Y3 = fp2_sub(
+      fp2_mul(fp2_mul_small(A, 3), fp2_sub(fp2_mul_small(D, 4), Fv)),
+      fp2_mul_small(fp2_mul(fp2_sq(B), C), 8));
+  Fp2 t = fp2_mul_small(YZ, 2);
+  Fp2 Z3 = fp2_mul(fp2_sq(t), t);
+  lA = fp2_sub(fp2_mul(T.X, A), fp2_mul_small(fp2_mul_xi(fp2_mul(T.Z, C)), 8));
+  lB = fp2_neg(fp2_mul_small(fp2_mul(A, T.Z), 3));
+  lC = fp2_mul_small(fp2_mul(T.Y, C), 2);
+  T = {X3, Y3, Z3};
+}
+
+static inline void proj_add_step(ProjT &T, const Fp2 &qx, const Fp2 &qy,
+                                 Fp2 &lA, Fp2 &lB, Fp2 &lC) {
+  Fp2 theta = fp2_sub(T.Y, fp2_mul(qy, T.Z));
+  Fp2 lam = fp2_sub(T.X, fp2_mul(qx, T.Z));
+  Fp2 lam2 = fp2_sq(lam);
+  Fp2 lam3 = fp2_mul(lam2, lam);
+  Fp2 H = fp2_sub(fp2_mul(fp2_sq(theta), T.Z),
+                  fp2_mul(lam2, fp2_add(T.X, fp2_mul(qx, T.Z))));
+  Fp2 X3 = fp2_mul(lam, H);
+  Fp2 Y3 = fp2_sub(fp2_mul(theta, fp2_sub(fp2_mul(lam2, T.X), H)),
+                   fp2_mul(lam3, T.Y));
+  Fp2 Z3 = fp2_mul(lam3, T.Z);
+  lA = fp2_sub(fp2_mul(theta, qx), fp2_mul(lam, qy));
+  lB = fp2_neg(theta);
+  lC = lam;
+  T = {X3, Y3, Z3};
+}
+
+// Accumulate one pair's Miller factor into f. P=(px,py) G1 affine,
+// Q=(qx,qy) twist affine; both non-infinite (caller filters).
+static void miller_accumulate(Fp12 &f, const Fp &px, const Fp &py,
+                              const Fp2 &qx, const Fp2 &qy) {
+  ProjT T = {qx, qy, FP2_ONE};
+  Fp2 lA, lB, lC;
+  // msb-first over |x| bits, skipping the leading 1
+  int top = 63;
+  while (!((BLS_X_ABS >> top) & 1)) top--;
+  Fp12 g = FP12_ONE;
+  for (int i = top - 1; i >= 0; i--) {
+    g = fp12_sq(g);
+    proj_double_step(T, lA, lB, lC);
+    g = fp12_mul_line(g, lA, fp2_mul_fp(lB, px), fp2_mul_fp(lC, py));
+    if ((BLS_X_ABS >> i) & 1) {
+      proj_add_step(T, qx, qy, lA, lB, lC);
+      g = fp12_mul_line(g, lA, fp2_mul_fp(lB, px), fp2_mul_fp(lC, py));
+    }
+  }
+  g = fp12_conj(g);  // x < 0
+  f = fp12_mul(f, g);
+}
+
+// NOTE: squaring the per-pair factor separately then multiplying loses the
+// shared-squaring optimization of a true multi-Miller loop; the batch API
+// below instead interleaves pairs inside ONE loop:
+
+static Fp12 multi_miller(const Fp *pxs, const Fp *pys, const Fp2 *qxs,
+                         const Fp2 *qys, const bool *skip, int n) {
+  std::vector<ProjT> T(n);
+  for (int i = 0; i < n; i++)
+    if (!skip[i]) T[i] = {qxs[i], qys[i], FP2_ONE};
+  int top = 63;
+  while (!((BLS_X_ABS >> top) & 1)) top--;
+  Fp12 f = FP12_ONE;
+  Fp2 lA, lB, lC;
+  for (int i = top - 1; i >= 0; i--) {
+    f = fp12_sq(f);
+    for (int j = 0; j < n; j++) {
+      if (skip[j]) continue;
+      proj_double_step(T[j], lA, lB, lC);
+      f = fp12_mul_line(f, lA, fp2_mul_fp(lB, pxs[j]), fp2_mul_fp(lC, pys[j]));
+    }
+    if ((BLS_X_ABS >> i) & 1) {
+      for (int j = 0; j < n; j++) {
+        if (skip[j]) continue;
+        proj_add_step(T[j], qxs[j], qys[j], lA, lB, lC);
+        f = fp12_mul_line(f, lA, fp2_mul_fp(lB, pxs[j]),
+                          fp2_mul_fp(lC, pys[j]));
+      }
+    }
+  }
+  return fp12_conj(f);  // x < 0
+}
+
+static Fp12 fp12_pow_x_abs(const Fp12 &m) {
+  int top = 63;
+  while (!((BLS_X_ABS >> top) & 1)) top--;
+  Fp12 acc = m;
+  for (int i = top - 1; i >= 0; i--) {
+    acc = fp12_sq(acc);
+    if ((BLS_X_ABS >> i) & 1) acc = fp12_mul(acc, m);
+  }
+  return acc;
+}
+
+static inline Fp12 fp12_pow_x_neg(const Fp12 &m) {
+  return fp12_conj(fp12_pow_x_abs(m));
+}
+
+// Identical chain to the spec's final_exp_chain (ops/pairing.py:269-289)
+static Fp12 final_exp(const Fp12 &f) {
+  Fp12 m = fp12_mul(fp12_conj(f), fp12_inv(f));
+  m = fp12_mul(fp12_frobenius2(m), m);
+  Fp12 t0 = fp12_mul(fp12_pow_x_neg(m), fp12_conj(m));
+  Fp12 t1 = fp12_mul(fp12_pow_x_neg(t0), fp12_conj(t0));
+  Fp12 t2 = fp12_mul(fp12_pow_x_neg(t1), fp12_frobenius(t1));
+  Fp12 t3 = fp12_mul(fp12_mul(fp12_pow_x_neg(fp12_pow_x_neg(t2)),
+                              fp12_frobenius2(t2)),
+                     fp12_conj(t2));
+  return fp12_mul(t3, fp12_mul(fp12_sq(m), m));
+}
+
+// ---------------------------------------------------------------------------
+// Codec helpers for the C ABI
+// ---------------------------------------------------------------------------
+
+static bool g1_load(const uint8_t *b, Fp &x, Fp &y) {  // returns inf flag
+  bool allz = true;
+  for (int i = 0; i < 96; i++)
+    if (b[i]) {
+      allz = false;
+      break;
+    }
+  if (allz) {
+    x = FP_ZERO;
+    y = FP_ZERO;
+    return true;
+  }
+  x = fp_from_le(b);
+  y = fp_from_le(b + 48);
+  return false;
+}
+
+static void g1_store(uint8_t *b, const Fp &x, const Fp &y, bool inf) {
+  if (inf) {
+    memset(b, 0, 96);
+    return;
+  }
+  fp_to_le(x, b);
+  fp_to_le(y, b + 48);
+}
+
+static bool g2_load(const uint8_t *b, Fp2 &x, Fp2 &y) {
+  bool allz = true;
+  for (int i = 0; i < 192; i++)
+    if (b[i]) {
+      allz = false;
+      break;
+    }
+  if (allz) {
+    x = FP2_ZERO;
+    y = FP2_ZERO;
+    return true;
+  }
+  x.c0 = fp_from_le(b);
+  x.c1 = fp_from_le(b + 48);
+  y.c0 = fp_from_le(b + 96);
+  y.c1 = fp_from_le(b + 144);
+  return false;
+}
+
+static void g2_store(uint8_t *b, const Fp2 &x, const Fp2 &y, bool inf) {
+  if (inf) {
+    memset(b, 0, 192);
+    return;
+  }
+  fp_to_le(x.c0, b);
+  fp_to_le(x.c1, b + 48);
+  fp_to_le(y.c0, b + 96);
+  fp_to_le(y.c1, b + 144);
+}
+
+static Scalar scalar_load(const uint8_t *b) {
+  Scalar s;
+  for (int i = 0; i < 4; i++) {
+    u64 w = 0;
+    for (int j = 0; j < 8; j++) w |= (u64)b[i * 8 + j] << (8 * j);
+    s.v[i] = w;
+  }
+  return s;
+}
+
+// ---------------------------------------------------------------------------
+// Init
+// ---------------------------------------------------------------------------
+
+static void ccbls_init() {
+  static bool done = false;
+  if (done) return;
+  done = true;
+  // FP_ONE = R mod p = mont(1): compute from RR via mont-mul with 1
+  Fp raw1 = {{1, 0, 0, 0, 0, 0}};
+  Fp rr;
+  memcpy(rr.v, RR, 48);
+  FP_ONE = fp_mul(raw1, rr);
+  FP2_ONE = {FP_ONE, FP_ZERO};
+  FP6_ONE = {FP2_ONE, FP2_ZERO, FP2_ZERO};
+  FP12_ONE = {FP6_ONE, FP6_ZERO};
+
+  // (p-1)/6 as limbs for the gamma pows
+  u64 e[6];
+  memcpy(e, PL, 48);
+  e[0] -= 1;  // p-1 (p odd)
+  // divide by 6
+  u128 rem = 0;
+  u64 q6[6];
+  for (int i = 5; i >= 0; i--) {
+    u128 cur = (rem << 64) | e[i];
+    q6[i] = (u64)(cur / 6);
+    rem = cur % 6;
+  }
+  Fp2 xi = {FP_ONE, FP_ONE};
+  G1C[0] = FP2_ONE;
+  G1C[1] = fp2_pow(xi, q6, 6);
+  for (int i = 2; i < 6; i++) G1C[i] = fp2_mul(G1C[i - 1], G1C[1]);
+  for (int i = 0; i < 6; i++) G2C[i] = fp2_mul(G1C[i], fp2_conj(G1C[i]));
+}
+
+// ---------------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Shared-base batched MSM in G1. bases: k*96B affine; scalars: B*k*32B;
+// out: B*96B affine. ct != 0 selects the masked-lookup schedule.
+void cc_msm_g1(const uint8_t *bases, const uint8_t *scalars, int k, int B,
+               uint8_t *out, int ct) {
+  ccbls_init();
+  std::vector<Fp> bx(k), by(k);
+  std::vector<bool> binfv(k);
+  std::vector<char> binf(k);
+  for (int j = 0; j < k; j++) {
+    binf[j] = g1_load(bases + (size_t)j * 96, bx[j], by[j]);
+  }
+  std::vector<Jac<Fp>> tables;
+  msm_tables<Fp>(bx.data(), by.data(), (const bool *)binf.data(), k, tables);
+  std::vector<Scalar> srow(k);
+  for (int i = 0; i < B; i++) {
+    for (int j = 0; j < k; j++)
+      srow[j] = scalar_load(scalars + ((size_t)i * k + j) * 32);
+    Jac<Fp> acc = ct ? msm_row_ct<Fp>(tables, srow.data(), k)
+                     : msm_row<Fp>(tables, srow.data(), k);
+    Fp x, y;
+    bool inf;
+    jac_to_affine(acc, x, y, inf);
+    g1_store(out + (size_t)i * 96, x, y, inf);
+  }
+}
+
+void cc_msm_g2(const uint8_t *bases, const uint8_t *scalars, int k, int B,
+               uint8_t *out, int ct) {
+  ccbls_init();
+  std::vector<Fp2> bx(k), by(k);
+  std::vector<char> binf(k);
+  for (int j = 0; j < k; j++) {
+    binf[j] = g2_load(bases + (size_t)j * 192, bx[j], by[j]);
+  }
+  std::vector<Jac<Fp2>> tables;
+  msm_tables<Fp2>(bx.data(), by.data(), (const bool *)binf.data(), k, tables);
+  std::vector<Scalar> srow(k);
+  for (int i = 0; i < B; i++) {
+    for (int j = 0; j < k; j++)
+      srow[j] = scalar_load(scalars + ((size_t)i * k + j) * 32);
+    Jac<Fp2> acc = ct ? msm_row_ct<Fp2>(tables, srow.data(), k)
+                      : msm_row<Fp2>(tables, srow.data(), k);
+    Fp2 x, y;
+    bool inf;
+    jac_to_affine(acc, x, y, inf);
+    g2_store(out + (size_t)i * 192, x, y, inf);
+  }
+}
+
+// Batched pairing-product check: for each row i of n pairs,
+// out[i] = (prod_j e(P_ij, Q_ij) == 1). Pairs with either side infinite
+// contribute the factor 1 (the spec's None convention).
+void cc_pairing_product_is_one(const uint8_t *ps, const uint8_t *qs, int n,
+                               int B, uint8_t *out) {
+  ccbls_init();
+  std::vector<Fp> pxs(n), pys(n);
+  std::vector<Fp2> qxs(n), qys(n);
+  std::vector<char> skip(n);
+  for (int i = 0; i < B; i++) {
+    for (int j = 0; j < n; j++) {
+      bool pinf = g1_load(ps + ((size_t)i * n + j) * 96, pxs[j], pys[j]);
+      bool qinf = g2_load(qs + ((size_t)i * n + j) * 192, qxs[j], qys[j]);
+      skip[j] = pinf || qinf;
+    }
+    Fp12 f = multi_miller(pxs.data(), pys.data(), qxs.data(), qys.data(),
+                          (const bool *)skip.data(), n);
+    out[i] = fp12_eq_one(final_exp(f)) ? 1 : 0;
+  }
+}
+
+// Single scalar mults (protocol-layer helpers): B points x B scalars.
+void cc_g1_mul(const uint8_t *pts, const uint8_t *scalars, int B,
+               uint8_t *out) {
+  ccbls_init();
+  for (int i = 0; i < B; i++) {
+    Fp x, y;
+    bool inf = g1_load(pts + (size_t)i * 96, x, y);
+    Scalar s = scalar_load(scalars + (size_t)i * 32);
+    if (inf) {
+      g1_store(out + (size_t)i * 96, FP_ZERO, FP_ZERO, true);
+      continue;
+    }
+    Jac<Fp> acc = jac_inf<Fp>();
+    for (int w = 0; w < 64; w++) {
+      if (w)
+        for (int d = 0; d < 4; d++) acc = jac_double(acc);
+      unsigned dg = scalar_window(s, w);
+      if (dg) {
+        Jac<Fp> base = {x, y, FP_ONE};
+        Jac<Fp> t = jac_inf<Fp>();
+        for (unsigned b = 0; b < dg; b++) t = jac_add_affine(t, x, y, false);
+        acc = jac_add(acc, t);
+      }
+    }
+    Fp ox, oy;
+    bool oinf;
+    jac_to_affine(acc, ox, oy, oinf);
+    g1_store(out + (size_t)i * 96, ox, oy, oinf);
+  }
+}
+
+int cc_selftest() {
+  ccbls_init();
+  // 1 in, 1 out through the Montgomery codec
+  uint8_t buf[48] = {0};
+  buf[0] = 5;
+  Fp a = fp_from_le(buf);
+  Fp b = fp_mul(a, fp_inv(a));
+  if (!fp_eq_raw(b, FP_ONE)) return 1;
+  // frobenius consistency: frob applied 12x is identity on a random-ish elt
+  Fp12 x = FP12_ONE;
+  x.c1.c1 = {a, fp_add(a, FP_ONE)};
+  x.c0.c2 = {fp_sq(a), a};
+  Fp12 y = x;
+  for (int i = 0; i < 12; i++) y = fp12_frobenius(y);
+  const u64 *xa = (const u64 *)&x, *ya = (const u64 *)&y;
+  for (size_t i = 0; i < sizeof(Fp12) / 8; i++)
+    if (xa[i] != ya[i]) return 2;
+  // frob2 == frob applied twice
+  Fp12 f2a = fp12_frobenius2(x);
+  Fp12 f2b = fp12_frobenius(fp12_frobenius(x));
+  const u64 *pa = (const u64 *)&f2a, *pb = (const u64 *)&f2b;
+  for (size_t i = 0; i < sizeof(Fp12) / 8; i++)
+    if (pa[i] != pb[i]) return 3;
+  return 0;
+}
+
+}  // extern "C"

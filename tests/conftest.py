@@ -1,14 +1,29 @@
 """Test configuration.
 
 Tests run on a virtual 8-device CPU mesh (the driver separately dry-runs the
-multi-chip path): env vars must be set before any `import jax` anywhere.
+multi-chip path and bench.py uses the real chip). Two mechanisms, both
+needed:
+
+  - XLA_FLAGS must carry --xla_force_host_platform_device_count=8 before the
+    CPU client initializes;
+  - the platform must be forced via jax.config *after* import: in this
+    environment a sitecustomize hook registers the tunneled TPU ("axon")
+    PJRT plugin and pins JAX_PLATFORMS=axon at interpreter start, so the env
+    var alone is overridden. config.update wins over both.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover - jax is baked into this image
+    pass

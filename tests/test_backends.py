@@ -39,6 +39,10 @@ def available_backends():
         names.append("jax")
     except ImportError:
         pass
+    from coconut_tpu import native
+
+    if native.available():
+        names.append("cpp")
     return names
 
 
@@ -124,6 +128,30 @@ class TestPrimitives:
         want = [g1.msm(bases, row) for row in scalars]
         assert got == want
 
+    def test_msm_g1_distinct(self, backend):
+        k = 3
+        pts = [
+            [g1.mul(G1_GEN, rng.randrange(1, R)) for _ in range(k)]
+            for _ in range(4)
+        ]
+        scal = [[rng.randrange(R) for _ in range(k)] for _ in range(4)]
+        scal[2][1] = 0  # zero scalar lane
+        pts[3][0] = None  # identity base lane
+        got = backend.msm_g1_distinct(pts, scal)
+        want = [g1.msm(p, s) for p, s in zip(pts, scal)]
+        assert got == want
+
+    def test_msm_g2_distinct(self, backend):
+        k = 2
+        pts = [
+            [g2.mul(G2_GEN, rng.randrange(1, R)) for _ in range(k)]
+            for _ in range(3)
+        ]
+        scal = [[rng.randrange(R) for _ in range(k)] for _ in range(3)]
+        got = backend.msm_g2_distinct(pts, scal)
+        want = [g2.msm(p, s) for p, s in zip(pts, scal)]
+        assert got == want
+
     def test_pairing_product_is_one(self, backend):
         b = rng.randrange(1, R)
         good = [(G1_GEN, g2.mul(G2_GEN, b)), (g1.neg(g1.mul(G1_GEN, b)), G2_GEN)]
@@ -148,6 +176,37 @@ class TestBatchVerify:
             sigs[:4], msgs_list[:4], vk, params, backend="python"
         )
         assert [bool(x) for x in got] == expect[:4]
+
+
+class TestBatchIssuance:
+    """batch_blind_sign / batch_unblind vs the sequential per-request path
+    (BASELINE config 4; reference signature.rs:396-443)."""
+
+    def test_matches_sequential(self, backend, params, keypair):
+        from coconut_tpu.elgamal import elgamal_keygen
+        from coconut_tpu.signature import (
+            BlindSignature,
+            SignatureRequest,
+            batch_blind_sign,
+            batch_unblind,
+        )
+
+        sk, vk = keypair
+        elg_sk, elg_pk = elgamal_keygen(params.ctx.sig, params.g)
+        reqs, msgs_all = [], []
+        for _ in range(4):
+            msgs = [rng.randrange(R) for _ in range(MSG_COUNT)]
+            req, _ = SignatureRequest.new(msgs, 2, elg_pk, params)
+            reqs.append(req)
+            msgs_all.append(msgs)
+        got = batch_blind_sign(reqs, sk, params, backend=backend)
+        want = [BlindSignature.new(r, sk, params) for r in reqs]
+        assert [(b.h, b.blinded) for b in got] == [
+            (b.h, b.blinded) for b in want
+        ]
+        sigs = batch_unblind(got, elg_sk, params.ctx, backend=backend)
+        for sig, msgs in zip(sigs, msgs_all):
+            assert ps_verify(sig, msgs, vk, params)
 
 
 def test_python_backend_is_default_registry():

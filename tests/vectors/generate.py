@@ -1,0 +1,200 @@
+"""Generate the known-answer vectors (VERDICT round-1 item 8).
+
+Pins golden bytes for the primitives every future backend (C++/TPU) must
+reproduce verbatim: expand_message_xmd, hash_to_g1/g2, a fixed-label params
+blob, field-arithmetic identities, one full credential transcript (issuance
+through verification with a fixed RNG seed), and pairing values.
+
+Run from the repo root:  python tests/vectors/generate.py
+Output: tests/vectors/*.json (committed; tests/test_vectors.py replays them).
+"""
+
+import json
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from coconut_tpu.ops import serialize as ser
+from coconut_tpu.ops.curve import G1_GEN, G2_GEN, g1, g2
+from coconut_tpu.ops.fields import P, R, fp12_mul
+from coconut_tpu.ops.hashing import (
+    expand_message_xmd,
+    hash_to_fr,
+    hash_to_g1,
+    hash_to_g2,
+)
+from coconut_tpu.ops.pairing import pairing
+from coconut_tpu.params import Params
+from coconut_tpu.ps import ps_verify
+from coconut_tpu.signature import Signature, Sigkey, Verkey
+
+OUT = os.path.dirname(os.path.abspath(__file__))
+
+
+def write(name, obj):
+    path = os.path.join(OUT, name)
+    with open(path, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+    print("wrote", path)
+
+
+def gen_hashing():
+    cases = []
+    for msg, dst, n in [
+        (b"", b"CTH-v1-TEST", 32),
+        (b"abc", b"CTH-v1-TEST", 64),
+        (b"coconut", b"CTH-v1-G1", 96),
+    ]:
+        cases.append(
+            {
+                "msg": msg.hex(),
+                "dst": dst.hex(),
+                "len": n,
+                "out": expand_message_xmd(msg, dst, n).hex(),
+            }
+        )
+    h2f = [
+        {"msg": m.hex(), "fr": hex(hash_to_fr(m))}
+        for m in (b"", b"fiat-shamir", b"x" * 100)
+    ]
+    h2g1 = [
+        {"msg": m.hex(), "point": ser.g1_to_compressed(hash_to_g1(m)).hex()}
+        for m in (b"", b"label : g", b"test vector 2")
+    ]
+    h2g2 = [
+        {"msg": m.hex(), "point": ser.g2_to_compressed(hash_to_g2(m)).hex()}
+        for m in (b"", b"label : g_tilde")
+    ]
+    write(
+        "hashing.json",
+        {
+            "expand_message_xmd": cases,
+            "hash_to_fr": h2f,
+            "hash_to_g1": h2g1,
+            "hash_to_g2": h2g2,
+        },
+    )
+
+
+def gen_params():
+    params = Params.new(3, b"kat-params-v1")
+    write(
+        "params.json",
+        {"label": b"kat-params-v1".hex(), "msg_count": 3, "blob": params.to_bytes().hex()},
+    )
+
+
+def gen_curve():
+    rng = random.Random(0x60D)
+    cases = []
+    for _ in range(4):
+        a, b = rng.randrange(1, R), rng.randrange(1, R)
+        pa, pb = g1.mul(G1_GEN, a), g1.mul(G1_GEN, b)
+        cases.append(
+            {
+                "a": hex(a),
+                "b": hex(b),
+                "g1_a": ser.g1_to_bytes(pa).hex(),
+                "g1_add": ser.g1_to_bytes(g1.add(pa, pb)).hex(),
+                "g1_msm": ser.g1_to_bytes(g1.msm([pa, pb], [b, a])).hex(),
+                "g2_a": ser.g2_to_bytes(g2.mul(G2_GEN, a)).hex(),
+            }
+        )
+    write("curve.json", {"cases": cases})
+
+
+def gen_pairing():
+    rng = random.Random(0xA1)
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    e = pairing(g1.mul(G1_GEN, a), g2.mul(G2_GEN, b))
+    e2 = pairing(G1_GEN, G2_GEN)
+    # serialize GT (Fp12 nested tuples) as flat hex list of 12 Fp ints
+    def flat(x):
+        out = []
+
+        def rec(t):
+            if isinstance(t, tuple):
+                for u in t:
+                    rec(u)
+            else:
+                out.append(hex(t))
+
+        rec(x)
+        return out
+
+    write(
+        "pairing.json",
+        {
+            "a": hex(a),
+            "b": hex(b),
+            "e_aG1_bG2": flat(e),
+            "e_G1_G2": flat(e2),
+            "bilinearity_ab": flat(
+                pairing(g1.mul(G1_GEN, a * b % R), G2_GEN)
+            ),
+        },
+    )
+
+
+def gen_transcript():
+    """Full credential lifecycle with fixed randomness (seeded), recorded at
+    the wire level: params, keys, messages, signature, verify bit."""
+    rng = random.Random(0x7EA)
+    params = Params.new(4, b"kat-transcript-v1")
+    sk = Sigkey(rng.randrange(1, R), [rng.randrange(1, R) for _ in range(4)])
+    ops = params.ctx.other
+    vk = Verkey(
+        ops.mul(params.g_tilde, sk.x),
+        [ops.mul(params.g_tilde, y) for y in sk.y],
+    )
+    msgs = [rng.randrange(R) for _ in range(4)]
+    t = rng.randrange(1, R)
+    s1 = params.ctx.sig.mul(params.g, t)
+    expo = (sk.x + sum(y * m for y, m in zip(sk.y, msgs))) % R
+    sig = Signature(s1, params.ctx.sig.mul(s1, expo))
+    assert ps_verify(sig, msgs, vk, params)
+    bad_msgs = list(msgs)
+    bad_msgs[0] = (bad_msgs[0] + 1) % R
+    assert not ps_verify(sig, bad_msgs, vk, params)
+    write(
+        "transcript.json",
+        {
+            "label": b"kat-transcript-v1".hex(),
+            "sk_x": hex(sk.x),
+            "sk_y": [hex(y) for y in sk.y],
+            "vk": vk.to_bytes(params.ctx).hex(),
+            "msgs": [hex(m) for m in msgs],
+            "sig": sig.to_bytes(params.ctx).hex(),
+            "verifies": True,
+            "bad_msgs": [hex(m) for m in bad_msgs],
+            "bad_verifies": False,
+        },
+    )
+
+
+def gen_fields():
+    rng = random.Random(0xF1E1D)
+    cases = []
+    for _ in range(4):
+        a, b = rng.randrange(P), rng.randrange(P)
+        cases.append(
+            {
+                "a": hex(a),
+                "b": hex(b),
+                "add": hex((a + b) % P),
+                "mul": hex(a * b % P),
+                "inv_a": hex(pow(a, -1, P)) if a else "0x0",
+            }
+        )
+    write("fields.json", {"p": hex(P), "r": hex(R), "fp_cases": cases})
+
+
+if __name__ == "__main__":
+    gen_fields()
+    gen_hashing()
+    gen_params()
+    gen_curve()
+    gen_pairing()
+    gen_transcript()
