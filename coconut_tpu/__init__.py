@@ -53,7 +53,7 @@ from .params import (  # noqa
     Params,
 )
 from .pok_sig import PoKOfSignature, PoKOfSignatureProof, show, show_verify  # noqa
-from .ps import batch_verify, ps_verify  # noqa
+from .ps import batch_show_verify, batch_verify, ps_verify  # noqa
 from .signature import (  # noqa
     BlindSignature,
     Sigkey,

@@ -67,6 +67,54 @@ def batch_verify(sigs, messages_list, vk, params, backend=None):
     ]
 
 
+def batch_show_verify(
+    proofs, vk, params, revealed_msgs_list, challenges=None, backend=None
+):
+    """Batched `PoKOfSignatureProof.verify` (BASELINE config 3).
+
+    challenges=None recomputes each Fiat-Shamir challenge from the proof
+    transcript (the secure non-interactive path). A backend accelerates the
+    uniform case (every proof reveals the same index set — the bench shape);
+    ragged batches fall back to the sequential path."""
+    from .signature import fiat_shamir_challenge
+
+    if len(proofs) != len(revealed_msgs_list):
+        raise PSError(
+            "batch size mismatch: %d proofs, %d revealed maps"
+            % (len(proofs), len(revealed_msgs_list))
+        )
+    if challenges is None:
+        challenges = [
+            fiat_shamir_challenge(p.to_bytes_for_challenge(vk, params))
+            for p in proofs
+        ]
+    elif len(challenges) != len(proofs):
+        raise PSError(
+            "batch size mismatch: %d proofs, %d challenges"
+            % (len(proofs), len(challenges))
+        )
+    for p, rm in zip(proofs, revealed_msgs_list):
+        if set(rm.keys()) != p.revealed_msg_indices:
+            raise PSError("revealed messages do not match proof's indices")
+    uniform = bool(proofs) and all(
+        p.revealed_msg_indices == proofs[0].revealed_msg_indices
+        for p in proofs
+    )
+    if backend is not None and uniform:
+        if isinstance(backend, str):
+            from .backend import get_backend
+
+            backend = get_backend(backend)
+        if hasattr(backend, "batch_show_verify"):
+            return backend.batch_show_verify(
+                proofs, vk, params, revealed_msgs_list, challenges
+            )
+    return [
+        p.verify(vk, params, rm, c)
+        for p, rm, c in zip(proofs, revealed_msgs_list, challenges)
+    ]
+
+
 class PoKOfSignature:
     """Commitment phase of the selective-disclosure proof ("Show" from the
     Coconut paper; reference surface pok_sig.rs:85-95).

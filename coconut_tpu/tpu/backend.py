@@ -34,7 +34,7 @@ from ..ops.fields import R
 from . import curve as cv
 from . import pairing as pr
 from . import tower as tw
-from .limbs import fr_to_digits
+from .limbs import fr_digits_np
 
 _WINDOW = 4
 _NDIG = 64
@@ -70,14 +70,11 @@ def _r128_digits(r):
 
 
 def _digits(scalars_batch):
-    return jnp.asarray(
-        np.stack(
-            [
-                np.stack([fr_to_digits(s, _WINDOW) for s in row])
-                for row in scalars_batch
-            ]
-        )
-    )
+    """[B][k] ints -> uint32 [B, k, 64] window digits (vectorized)."""
+    B = len(scalars_batch)
+    k = len(scalars_batch[0]) if B else 0
+    flat = [s for row in scalars_batch for s in row]
+    return jnp.asarray(fr_digits_np(flat).reshape(B, k, _NDIG))
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -151,26 +148,23 @@ _fused_verify_kernel = functools.partial(jax.jit, static_argnums=(0,))(
 )
 
 
-def _tree_fold_points(fl, pts, n):
-    """Jacobian sum of a [n]-leading pytree by halving jadds (n pow2)."""
-    while n > 1:
-        half = n // 2
-        a = jax.tree_util.tree_map(lambda t: t[:half], pts)
-        b = jax.tree_util.tree_map(lambda t: t[half:], pts)
-        pts = cv.jadd(fl, a, b)
-        n = half
-    return pts
-
-
 def _tree_fold_fp12(f, n):
-    """Product of a [n]-leading Fp12 pytree by halving muls (n pow2)."""
-    while n > 1:
-        half = n // 2
-        a = jax.tree_util.tree_map(lambda t: t[:half], f)
-        b = jax.tree_util.tree_map(lambda t: t[half:], f)
-        f = tw.fp12_mul(a, b)
-        n = half
-    return f
+    """Product of a [n]-leading Fp12 pytree (n pow2) with the same
+    fixed-shape butterfly as cv.fold_points: fp12_mul compiles ONCE.
+    Junk lanes past the stride are ignored; lane 0 is the product.
+    Returns a [1]-leading pytree."""
+    assert n & (n - 1) == 0
+    steps = n.bit_length() - 1
+
+    def body(i, buf):
+        stride = jax.lax.shift_right_logical(jnp.int32(n), i + 1)
+        shifted = jax.tree_util.tree_map(
+            lambda t: jnp.roll(t, -stride, axis=0), buf
+        )
+        return tw.fp12_mul(buf, shifted)
+
+    buf = jax.lax.fori_loop(0, steps, body, f)
+    return jax.tree_util.tree_map(lambda t: t[:1], buf)
 
 
 def fused_verify_combined(
@@ -213,9 +207,7 @@ def fused_verify_combined(
         sig_fl.select(dead, i_, c)
         for i_, c in zip(cv.jinfinity(sig_fl, (B,)), s2rn)
     )
-    s2sum = jax.tree_util.tree_map(
-        lambda t: t[0], _tree_fold_points(sig_fl, s2rn, B)
-    )
+    s2sum = cv.fold_points(sig_fl, s2rn, B)
     sx, sy, sinf = cv.to_affine(sig_fl, s1r)
     zx, zy, zinf = cv.to_affine(sig_fl, s2sum)
 
@@ -252,6 +244,183 @@ def fused_verify_combined(
 _fused_verify_combined_kernel = functools.partial(
     jax.jit, static_argnums=(0,)
 )(fused_verify_combined)
+
+
+def _grouped_msms(fl, x, y, inf, digits):
+    """M MSMs over the SAME [B] points: digits [M, B, 64] (4-bit, msb
+    first) -> Jacobian accumulators [M].
+
+    One on-device table build (14 batched adds over [B]), then per window:
+    4 doublings on [M] accumulators, a [M, B] table gather, and a log2(B)
+    tree-fold. This is the whole per-credential cost of the grouped verify —
+    no G2 arithmetic, no per-credential pairing."""
+    tables = cv.build_tables_device(fl, x, y, inf)  # leaves [B, 16, ...]
+    M, B, nwin = digits.shape
+    acc = cv.jinfinity(fl, (M,))
+
+    def gather(dw):
+        # dw: [M, B] -> [M, B] points from tables [B, 16, ...]
+        def leaf(t):
+            idx = dw.reshape(dw.shape + (1,) * (t.ndim - 1))
+            return jnp.take_along_axis(
+                jnp.broadcast_to(t[None], (M,) + t.shape), idx, axis=2
+            )[:, :, 0]
+
+        return jax.tree_util.tree_map(leaf, tables)
+
+    def body(acc, dw):
+        acc = jax.lax.fori_loop(0, 4, lambda _, a: cv.jdouble(fl, a), acc)
+        pts = gather(dw)
+        s = cv.fold_points(fl, pts, B, axis_offset=1)
+        return cv.jadd(fl, acc, s), None
+
+    acc, _ = jax.lax.scan(body, acc, jnp.moveaxis(digits, -1, 0))
+    return acc
+
+
+def fused_verify_grouped(
+    sig_is_g1, s1, s2n, inf1, inf2, cdigits, rdigits, ox, oy, gtx, gty
+):
+    """Attribute-grouped combined batch verify — ONE boolean, q+2 pairs
+    TOTAL regardless of batch size.
+
+    The small-exponents combination regrouped by verkey component: with
+    random 128-bit r_i and messages m_ij,
+
+      prod_i [e(s1_i, X * prod_j Y_j^{m_ij}) * e(-s2_i, g)]^{r_i}
+      = e(sum_i r_i s1_i, X)
+        * prod_j e(sum_i (r_i m_ij) s1_i, Y_j)
+        * e(sum_i r_i (-s2_i), g)
+
+    so ALL G2/OtherGroup arithmetic disappears (X, Y_j, g are fixed affine
+    inputs) and the per-credential work is q+2 shared-point G1 MSMs over the
+    batch (_grouped_msms). Soundness 2^-128 per forged credential, as in
+    fused_verify_combined.
+
+    Shapes: s1/s2n coordinate pytrees [B]; cdigits [q+1, B, 64] (scalars
+    r_i then r_i*m_ij mod r); rdigits [1, B, 64] (r_i for the -s2 sum);
+    ox/oy [q+1] other-group affine (X then Y_j); gtx/gty other-group affine
+    g. B power of two."""
+    sig_fl = cv.FP if sig_is_g1 else cv.FP2
+    oth_fl = cv.FP2 if sig_is_g1 else cv.FP
+    B = inf1.shape[0]
+    dead = inf1 | inf2
+
+    # dead lanes: zero digits (host guarantees) -> identity contributions
+    acc1 = _grouped_msms(sig_fl, s1[0], s1[1], inf1, cdigits)  # [q+1]
+    acc2 = _grouped_msms(sig_fl, s2n[0], s2n[1], inf2, rdigits)  # [1]
+    allacc = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b], axis=0), acc1, acc2
+    )
+    px, py, pinf = cv.to_affine(sig_fl, allacc)  # [q+2] sig-group points
+
+    qx = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b[None]], axis=0), ox, gtx
+    )
+    qy = jax.tree_util.tree_map(
+        lambda a, b: jnp.concatenate([a, b[None]], axis=0), oy, gty
+    )
+    valid = ~pinf  # a zero accumulator contributes the factor 1
+    npair = valid.shape[0]
+    if sig_is_g1:
+        f = pr.multi_miller_loop(
+            jax.tree_util.tree_map(lambda t: t[:, None], px),
+            jax.tree_util.tree_map(lambda t: t[:, None], py),
+            jax.tree_util.tree_map(lambda t: t[:, None], qx),
+            jax.tree_util.tree_map(lambda t: t[:, None], qy),
+            valid[:, None],
+        )
+    else:
+        f = pr.multi_miller_loop(
+            jax.tree_util.tree_map(lambda t: t[:, None], qx),
+            jax.tree_util.tree_map(lambda t: t[:, None], qy),
+            jax.tree_util.tree_map(lambda t: t[:, None], px),
+            jax.tree_util.tree_map(lambda t: t[:, None], py),
+            valid[:, None],
+        )
+    # fold the q+2 miller values (pad to a power of two with ones)
+    pow2 = 1 << (npair - 1).bit_length()
+    if pow2 != npair:
+        pad = tw.fp12_ones((pow2 - npair,))
+        f = jax.tree_util.tree_map(
+            lambda a, b: jnp.concatenate([a, b], axis=0), f, pad
+        )
+    prod = _tree_fold_fp12(f, pow2)
+    ok = tw.fp12_is_one(pr.final_exp(prod))[0]
+    return ok & ~jnp.any(dead)
+
+
+_fused_verify_grouped_kernel = functools.partial(
+    jax.jit, static_argnums=(0,)
+)(fused_verify_grouped)
+
+
+def fused_show_verify(
+    sig_is_g1,
+    vc_tables,
+    resp_digits,
+    jpt,
+    jinf,
+    cdigits_j,
+    commx,
+    commy,
+    comminf,
+    acc_tables,
+    acc_digits,
+    s1,
+    s2n,
+    gtx,
+    gty,
+    inf1,
+    inf2,
+):
+    """Batched PoKOfSignatureProof.verify (the Show/ShowVerify hot path,
+    BASELINE config 3; reference surface pok_sig.rs:103-105).
+
+    Two checks per proof, both on-device (cf. ps.PoKOfSignatureProof.verify
+    and pok_vc.Proof.verify):
+
+      1. Schnorr randomized-commitment equation over the OtherGroup:
+           prod_k bases_k^{resp_ik} * J_i^{c_i} == t_i
+         (bases = [g_tilde, hidden Y_tilde] shared across the batch ->
+         shared-table MSM; the J_i^{c_i} term is a k=1 distinct MSM;
+         t_i is the proof's commitment point, passed affine as commx/y).
+      2. Pairing check with the re-randomized signature:
+           e(sigma'_1i, J_i * X_tilde * prod_rev Y_tilde^m) * e(-sigma'_2i,
+           g_tilde) == 1
+         (shared-base MSM over [X_tilde, revealed Y_tilde] with scalars
+         [1, m_rev..]; J_i joins by one Jacobian add).
+
+    All proofs must share the same revealed-index set (the bench shape;
+    ps.batch_show_verify falls back per-proof otherwise)."""
+    oth_fl = cv.FP2 if sig_is_g1 else cv.FP
+
+    # -- Schnorr check ------------------------------------------------------
+    vc = cv.msm_shared(oth_fl, vc_tables, resp_digits)
+    jterm = cv.msm_distinct(
+        oth_fl,
+        jax.tree_util.tree_map(lambda t: t[:, None], jpt[0]),
+        jax.tree_util.tree_map(lambda t: t[:, None], jpt[1]),
+        jinf[:, None],
+        cdigits_j,
+    )
+    lhs = cv.jadd(oth_fl, vc, jterm)
+    lx, ly, linf = cv.to_affine(oth_fl, lhs)
+    schnorr_ok = (
+        oth_fl.eq(lx, commx) & oth_fl.eq(ly, commy) & ~linf & ~comminf
+    ) | (linf & comminf)
+
+    # -- pairing check ------------------------------------------------------
+    acc = cv.msm_shared(oth_fl, acc_tables, acc_digits)
+    jjac = cv.affine_to_jacobian(oth_fl, jpt[0], jpt[1], jinf)
+    acc = cv.jadd(oth_fl, acc, jjac)
+    pair_ok = verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2)
+    return schnorr_ok & pair_ok
+
+
+_fused_show_verify_kernel = functools.partial(jax.jit, static_argnums=(0,))(
+    fused_show_verify
+)
 
 
 class JaxBackend(CurveBackend):
@@ -360,6 +529,15 @@ class JaxBackend(CurveBackend):
         sig_pts_2n = [
             None if s.sigma_2 is None else ctx.sig.neg(s.sigma_2) for s in sigs
         ]
+        s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
+            ctx, sig_pts_1, sig_pts_2n, params
+        )
+        return (tables, digits, s1, s2n, gtx, gty, inf1, inf2)
+
+    def _encode_sigs_and_gt(self, ctx, sig_pts_1, sig_pts_2n, params):
+        """Signature-group point batches + the g_tilde constant, encoded for
+        whichever group assignment `ctx` names. Shared by the per-credential,
+        show-verify, and grouped paths."""
         if ctx.name == "G1":
             s1, inf1 = self._encode_g1_points(sig_pts_1)
             s2n, inf2 = self._encode_g1_points(sig_pts_2n)
@@ -372,7 +550,7 @@ class JaxBackend(CurveBackend):
 
             gtx = jnp.asarray(fp_encode(params.g_tilde[0]))
             gty = jnp.asarray(fp_encode(params.g_tilde[1]))
-        return (tables, digits, s1, s2n, gtx, gty, inf1, inf2)
+        return s1, s2n, inf1, inf2, gtx, gty
 
     def batch_verify(self, sigs, messages_list, vk, params):
         """Fully-fused batched PS verification (the north-star path)."""
@@ -432,6 +610,147 @@ class JaxBackend(CurveBackend):
             gty,
             inf1,
             inf2,
+        )
+        return bool(ok)
+
+    def batch_show_verify(
+        self, proofs, vk, params, revealed_msgs_list, challenges
+    ):
+        """Batched selective-disclosure proof verification (config 3).
+
+        All proofs must share one revealed-index set; `ps.batch_show_verify`
+        is the public API (it recomputes Fiat-Shamir challenges and falls
+        back to the sequential path on ragged batches)."""
+        ctx = params.ctx
+        B = len(proofs)
+        if B == 0:
+            return []
+        revealed = sorted(proofs[0].revealed_msg_indices)
+        hidden = [
+            i for i in range(len(vk.Y_tilde)) if i not in proofs[0].revealed_msg_indices
+        ]
+        oth = ctx.other
+        is_g1_ctx = ctx.name == "G1"
+
+        # Schnorr operands
+        vc_bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
+        vc_tables = _build_tables(oth, vc_bases)
+        resp_digits = _digits(
+            [[r % R for r in p.proof_vc.responses] for p in proofs]
+        )
+        enc_other = (
+            self._encode_g2_points if is_g1_ctx else self._encode_g1_points
+        )
+        (jx, jy), jinf = enc_other([p.J for p in proofs])
+        cdigits_j = _digits([[c % R] for c in challenges])
+        (commx, commy), comminf = enc_other([p.proof_vc.t for p in proofs])
+
+        # pairing operands
+        acc_bases = [vk.X_tilde] + [vk.Y_tilde[i] for i in revealed]
+        acc_tables = _build_tables(oth, acc_bases)
+        acc_digits = _digits(
+            [
+                [1] + [rm[i] % R for i in revealed]
+                for rm in revealed_msgs_list
+            ]
+        )
+        s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
+            ctx,
+            [p.sigma_prime_1 for p in proofs],
+            [
+                None if p.sigma_prime_2 is None else ctx.sig.neg(p.sigma_prime_2)
+                for p in proofs
+            ],
+            params,
+        )
+        bits = _fused_show_verify_kernel(
+            is_g1_ctx,
+            vc_tables,
+            resp_digits,
+            ((jx, jy)),
+            jinf,
+            cdigits_j,
+            commx,
+            commy,
+            comminf,
+            acc_tables,
+            acc_digits,
+            s1,
+            s2n,
+            gtx,
+            gty,
+            inf1,
+            inf2,
+        )
+        return [bool(b) for b in np.asarray(bits)]
+
+    def batch_verify_grouped(self, sigs, messages_list, vk, params):
+        """One boolean for the whole batch via the attribute-grouped
+        combination (fused_verify_grouped): q+2 pairings total, all
+        per-credential work in shared-point G1 MSMs. The fastest verify
+        path; soundness 2^-128 per forged credential."""
+        import secrets
+
+        B = len(sigs)
+        q = len(vk.Y_tilde)
+        if len(messages_list) != B:
+            raise ValueError(
+                "batch size mismatch: %d sigs, %d message vectors"
+                % (B, len(messages_list))
+            )
+        for msgs in messages_list:
+            if len(msgs) != q:
+                raise ValueError(
+                    "message vector length %d != msg_count %d"
+                    % (len(msgs), q)
+                )
+        if B == 0:
+            return True
+        if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
+            return False
+        Bp = 1 << max(1, (B - 1).bit_length())
+        pad = Bp - B
+        if pad:
+            sigs = list(sigs) + [sigs[0]] * pad
+            messages_list = list(messages_list) + [messages_list[0]] * pad
+        ctx = params.ctx
+        rs = [secrets.randbits(128) for _ in range(Bp)]
+        rows = [rs] + [
+            [r * (msgs[j] % R) % R for r, msgs in zip(rs, messages_list)]
+            for j in range(q)
+        ]
+        cdigits = jnp.asarray(
+            np.stack([fr_digits_np(row) for row in rows])
+        )  # [q+1, Bp, 64]
+        rdigits = cdigits[:1]
+
+        s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
+            ctx,
+            [s.sigma_1 for s in sigs],
+            [ctx.sig.neg(s.sigma_2) for s in sigs],
+            params,
+        )
+        others = [vk.X_tilde] + list(vk.Y_tilde)
+        if ctx.name == "G1":
+            ox = tw.encode_batch([p[0] for p in others])
+            oy = tw.encode_batch([p[1] for p in others])
+        else:
+            from .limbs import fp_encode_batch
+
+            ox = jnp.asarray(fp_encode_batch([p[0] for p in others]))
+            oy = jnp.asarray(fp_encode_batch([p[1] for p in others]))
+        ok = _fused_verify_grouped_kernel(
+            ctx.name == "G1",
+            s1,
+            s2n,
+            inf1,
+            inf2,
+            cdigits,
+            rdigits,
+            ox,
+            oy,
+            gtx,
+            gty,
         )
         return bool(ok)
 

@@ -172,16 +172,43 @@ def build_tables_device(fl, x, y, inf):
 
     x, y: affine coordinate pytrees [..., k]; inf: bool [..., k].
     Returns Jacobian pytree with leaves [..., k, 16, NLIMBS-ish] (a new axis
-    inserted before the limb dims). 14 batched jadds — amortized over the
+    inserted before the limb dims). The 15 chained adds run as a `lax.scan`
+    so jadd is compiled ONCE (unrolled, this function alone was ~91k HLO
+    lines and dominated the combined-kernel compile); amortized over the
     whole [..., k] batch, unlike the host-side spec-op tables of msm_shared
     (those are only viable when the bases are shared by every batch row)."""
     jac = affine_to_jacobian(fl, x, y, inf)
-    rows = [jinfinity(fl, inf.shape), jac]
-    for _ in range(14):
-        rows.append(jadd(fl, rows[-1], jac))
+
+    def body(prev, _):
+        return jadd(fl, prev, jac), prev  # emits entries 0..15
+
+    _, rows = jax.lax.scan(body, jinfinity(fl, inf.shape), None, length=16)
+    # rows leaves: [16, ..., k, L] -> [..., k, 16, L]
     return jax.tree_util.tree_map(
-        lambda *ls: jnp.stack(ls, axis=inf.ndim), *rows
+        lambda t: jnp.moveaxis(t, 0, inf.ndim), rows
     )
+
+
+def fold_points(fl, pts, n, axis_offset=0):
+    """Sum a pytree of n points along its (axis_offset)-th leading axis with
+    a fixed-shape butterfly: buf = jadd(buf, roll(buf, -stride)) for stride
+    = n/2, n/4, ... — jadd compiles ONCE (a halving tree would instantiate
+    log2(n) differently-shaped jadds). Lanes past the stride hold junk
+    (field ops stay in-range; point semantics is ignored); lane 0 ends as
+    the full sum. n must be a power of two."""
+    assert n & (n - 1) == 0
+    steps = n.bit_length() - 1
+    ax = axis_offset
+
+    def body(i, buf):
+        stride = jax.lax.shift_right_logical(jnp.int32(n), i + 1)
+        shifted = jax.tree_util.tree_map(
+            lambda t: jnp.roll(t, -stride, axis=ax), buf
+        )
+        return jadd(fl, buf, shifted)
+
+    buf = jax.lax.fori_loop(0, steps, body, pts)
+    return jax.tree_util.tree_map(lambda t: jnp.take(t, 0, axis=ax), buf)
 
 
 def msm_distinct(fl, x, y, inf, digits):

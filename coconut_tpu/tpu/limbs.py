@@ -143,3 +143,15 @@ def fr_to_digits(k, window=4):
         [(k >> (window * i)) & ((1 << window) - 1) for i in range(ndig - 1, -1, -1)],
         dtype=np.uint32,
     )
+
+
+def fr_digits_np(scalars):
+    """[n] iterable of ints -> np.uint32 [n, 64] 4-bit window digits, msb
+    first. Vectorized (bytes -> nibble split) — the per-scalar Python-loop
+    version costs ~0.5 ms/scalar, which dominates host encode at batch 1k."""
+    buf = b"".join((int(s) % R).to_bytes(32, "big") for s in scalars)
+    bs = np.frombuffer(buf, dtype=np.uint8).reshape(-1, 32)
+    out = np.empty((bs.shape[0], 64), dtype=np.uint32)
+    out[:, 0::2] = bs >> 4
+    out[:, 1::2] = bs & 0xF
+    return out

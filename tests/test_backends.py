@@ -11,6 +11,7 @@ issuance protocol — same verification math (reference signature.rs:472-478),
 much faster fixtures. The full-protocol path is covered in test_protocol.py.
 """
 
+import os
 import random
 
 import pytest
@@ -176,6 +177,100 @@ class TestBatchVerify:
             sigs[:4], msgs_list[:4], vk, params, backend="python"
         )
         assert [bool(x) for x in got] == expect[:4]
+
+
+heavy = pytest.mark.skipif(
+    os.environ.get("COCONUT_TEST_HEAVY") != "1",
+    reason="multi-minute XLA compile on the 1-core CPU mesh; "
+    "set COCONUT_TEST_HEAVY=1 (validated on the real chip by bench.py)",
+)
+
+
+class TestCombinedVerify:
+    """Small-exponents combined/grouped batch verification (one bool)."""
+
+    @heavy
+    def test_combined_matches_all(self, params, keypair, mixed_batch):
+        from coconut_tpu.backend import get_backend
+
+        be = get_backend("jax")
+        _, vk = keypair
+        sigs, msgs_list, expect = mixed_batch
+        ok = be.batch_verify_combined(sigs[:4], msgs_list[:4], vk, params)
+        assert ok == all(expect[:4])
+        good = [i for i, e in enumerate(expect) if e]
+        ok2 = be.batch_verify_combined(
+            [sigs[i] for i in good], [msgs_list[i] for i in good], vk, params
+        )
+        assert ok2 is True
+
+    @heavy
+    def test_grouped_matches_all(self, params, keypair, mixed_batch):
+        from coconut_tpu.backend import get_backend
+
+        be = get_backend("jax")
+        _, vk = keypair
+        sigs, msgs_list, expect = mixed_batch
+        ok = be.batch_verify_grouped(sigs[:4], msgs_list[:4], vk, params)
+        assert ok == all(expect[:4])
+        good = [i for i, e in enumerate(expect) if e]
+        ok2 = be.batch_verify_grouped(
+            [sigs[i] for i in good], [msgs_list[i] for i in good], vk, params
+        )
+        assert ok2 is True
+
+    def test_combined_empty_and_identity(self, params, keypair):
+        import jax  # noqa: F401 (jax-only path)
+
+        from coconut_tpu.backend import get_backend
+
+        be = get_backend("jax")
+        _, vk = keypair
+        assert be.batch_verify_combined([], [], vk, params) is True
+        assert be.batch_verify_grouped([], [], vk, params) is True
+        bad = [Signature(None, None)]
+        assert be.batch_verify_combined(bad, [[1] * MSG_COUNT], vk, params) is False
+        assert be.batch_verify_grouped(bad, [[1] * MSG_COUNT], vk, params) is False
+
+
+class TestBatchShowVerify:
+    """Batched selective-disclosure verification (config 3) vs sequential."""
+
+    def _make(self, params, keypair, n):
+        from coconut_tpu.pok_sig import show
+
+        sk, vk = keypair
+        proofs, rmls = [], []
+        for i in range(n):
+            msgs = [rng.randrange(R) for _ in range(MSG_COUNT)]
+            sig = direct_sign(sk, msgs, params)
+            proof, chal, revealed = show(sig, vk, params, msgs, {1, 4})
+            if i % 3 == 1:  # wrong revealed value
+                revealed = dict(revealed)
+                revealed[1] = (revealed[1] + 1) % R
+            if i % 3 == 2:  # corrupted Schnorr response
+                proof.proof_vc.responses[0] = (
+                    proof.proof_vc.responses[0] + 1
+                ) % R
+            proofs.append(proof)
+            rmls.append(revealed)
+        return proofs, rmls
+
+    def test_sequential_fallback(self, params, keypair):
+        from coconut_tpu.ps import batch_show_verify
+
+        proofs, rmls = self._make(params, keypair, 3)
+        bits = batch_show_verify(proofs, keypair[1], params, rmls)
+        assert bits == [True, False, False]
+
+    @heavy
+    def test_jax_matches_sequential(self, params, keypair):
+        from coconut_tpu.ps import batch_show_verify
+
+        proofs, rmls = self._make(params, keypair, 4)
+        seq = batch_show_verify(proofs, keypair[1], params, rmls)
+        got = batch_show_verify(proofs, keypair[1], params, rmls, backend="jax")
+        assert got == seq
 
 
 class TestBatchIssuance:
