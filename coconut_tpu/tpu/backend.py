@@ -149,22 +149,17 @@ _fused_verify_kernel = functools.partial(jax.jit, static_argnums=(0,))(
 
 
 def _tree_fold_fp12(f, n):
-    """Product of a [n]-leading Fp12 pytree (n pow2) with the same
-    fixed-shape butterfly as cv.fold_points: fp12_mul compiles ONCE.
-    Junk lanes past the stride are ignored; lane 0 is the product.
-    Returns a [1]-leading pytree."""
+    """Product of a [n]-leading Fp12 pytree (n pow2) by pairwise halving —
+    same rationale as cv.fold_points (~n-1 lane-muls instead of the
+    fixed-width butterfly's n*log2(n)). Returns a [1]-leading pytree."""
     assert n & (n - 1) == 0
-    steps = n.bit_length() - 1
-
-    def body(i, buf):
-        stride = jax.lax.shift_right_logical(jnp.int32(n), i + 1)
-        shifted = jax.tree_util.tree_map(
-            lambda t: jnp.roll(t, -stride, axis=0), buf
-        )
-        return tw.fp12_mul(buf, shifted)
-
-    buf = jax.lax.fori_loop(0, steps, body, f)
-    return jax.tree_util.tree_map(lambda t: t[:1], buf)
+    while n > 1:
+        half = n // 2
+        lo = jax.tree_util.tree_map(lambda t: t[:half], f)
+        hi = jax.tree_util.tree_map(lambda t: t[half:n], f)
+        f = tw.fp12_mul(lo, hi)
+        n = half
+    return f
 
 
 def fused_verify_combined(
@@ -298,7 +293,8 @@ def fused_verify_grouped(
     fused_verify_combined.
 
     Shapes: s1/s2n coordinate pytrees [B]; cdigits [q+1, B, 64] (scalars
-    r_i then r_i*m_ij mod r); rdigits [1, B, 64] (r_i for the -s2 sum);
+    r_i then r_i*m_ij mod r); rdigits [1, B, 32] (r_i for the -s2 sum —
+    r_i are 128-bit so only the low 32 msb-first windows are passed);
     ox/oy [q+1] other-group affine (X then Y_j); gtx/gty other-group affine
     g. B power of two."""
     sig_fl = cv.FP if sig_is_g1 else cv.FP2
@@ -722,7 +718,9 @@ class JaxBackend(CurveBackend):
         cdigits = jnp.asarray(
             np.stack([fr_digits_np(row) for row in rows])
         )  # [q+1, Bp, 64]
-        rdigits = cdigits[:1]
+        # r_i are 128-bit: the top 32 windows of the r-row are zero — slice
+        # them off so the -sigma_2 MSM runs half the window schedule
+        rdigits = cdigits[:1, :, 32:]
 
         s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
             ctx,

@@ -35,6 +35,8 @@ Why this representation (SURVEY.md §7 hard part (a), third redesign):
 The import-time asserts pin the exact bounds the algebra relies on.
 """
 
+import os
+
 import numpy as np
 
 import jax.numpy as jnp
@@ -88,18 +90,41 @@ for _i in range(NLIMBS):
     for _j in range(NLIMBS):
         _BAND_NP[_i * NLIMBS + _j, _i + _j] = 1.0
 _BAND = jnp.asarray(_BAND_NP, dtype=jnp.bfloat16)
+_BAND_I8 = jnp.asarray(_BAND_NP, dtype=jnp.int8)
+
+# int8 MXU path (default): the same two byte planes contracted as
+# int8 x int8 -> int32 matmuls — native int8 MXU peak is 2x bf16 on v5e and
+# every intermediate is still exact (planes in [-128, 127] by the floor
+# split; band sums <= 48*128 < 2^31). COCONUT_FP_INT8=0 falls back to bf16.
+_USE_INT8 = os.environ.get("COCONUT_FP_INT8", "1") == "1"
 
 
 def _school(a, b, out_len):
     """Polynomial limb product c_k = sum_{i+j=k} a_i * b_j, truncated to
     out_len limbs. |a_i|,|b_j| <= 135: outer products <= 135^2 < 2^15 (exact
-    f32); balanced byte planes <= 128 in magnitude (exact bf16); band sums
-    <= 48*128 (exact f32 accumulation on the MXU); recombined coefficients
-    <= 48*135^2 < 2^20 (exact f32)."""
+    f32); split into two byte planes with hi = floor((t+128)/256), so
+    lo = t - 256*hi in [-128, 127] and |hi| <= 72 — both exact in int8/bf16;
+    band sums of <= 48 terms accumulate exactly in int32/f32 on the MXU;
+    recombined coefficients <= 48*135^2 < 2^20 (exact f32)."""
     outer = a[..., :, None] * b[..., None, :]
     flat = outer.reshape(outer.shape[:-2] + (NLIMBS * NLIMBS,))
-    hi = jnp.round(flat * _INV_BASE)
+    hi = jnp.floor((flat + 128.0) * _INV_BASE)
     lo = flat - hi * _BASE
+    if _USE_INT8:
+        band = _BAND_I8[:, :out_len]
+        acc_lo = jnp.einsum(
+            "...x,xk->...k",
+            lo.astype(jnp.int8),
+            band,
+            preferred_element_type=jnp.int32,
+        )
+        acc_hi = jnp.einsum(
+            "...x,xk->...k",
+            hi.astype(jnp.int8),
+            band,
+            preferred_element_type=jnp.int32,
+        )
+        return (acc_lo + acc_hi * 256).astype(jnp.float32)
     band = _BAND[:, :out_len]
     acc_lo = jnp.einsum(
         "...x,xk->...k",
