@@ -2,7 +2,7 @@
 
 Everything under this package is the TPU-native equivalent of the reference's
 `amcl_wrapper` curve layer (SURVEY.md §2.2) re-designed for XLA: 381-bit base
-field elements are decomposed into 48 x 8-bit limbs held in float32 lanes,
+field elements are decomposed into 52 x 8-bit lazy signed limbs in float32,
 limb products run as bf16 matmuls with exact f32 accumulation ON THE MXU
 (see tpu/limbs.py for why this representation), every operation is natively
 batched over leading array dimensions, control flow is `lax.scan` over the

@@ -1,14 +1,25 @@
-"""Batched G1/G2 Jacobian arithmetic and shared-base windowed MSM.
+"""Batched G1/G2 complete projective arithmetic and windowed MSMs.
 
 The TPU equivalent of the reference's `multi_scalar_mul_const_time/_var_time`
 call sites (signature.rs:157,424,427,465,513,521), re-designed for XLA:
-points are pytrees of limb arrays, all control flow is branchless (select
-masks carry the identity/doubling edge cases), and the MSM loops over a
-static window schedule with per-batch-element table gathers.
+points are pytrees of limb arrays and the MSM loops run over a static
+window schedule with per-batch-element table gathers.
 
-Formulas match `ops.curve.CurveOps` (Jacobian: spec curve.py:95-143);
-only affine outputs are compared bit-for-bit — Jacobian representatives are
-not canonical.
+Point formulas are the Renes-Costello-Batina (2015) COMPLETE projective
+addition/doubling for short-Weierstrass curves with a = 0. BLS12-381's
+E(Fp) and its twist E'(Fp2) both have odd group order, so the formulas are
+valid for EVERY pair of inputs including the identity (0 : 1 : 0) — no
+branch predicates, no select masks, no embedded doubling in the hot path
+(the previous Jacobian implementation spent ~60% of its HLO and runtime on
+that edge-case machinery). Each formula's independent field products are
+stacked into single MXU contractions (fl.mul_many): 12 products in 3
+stacked multiplies per addition, 9 in 3 per doubling.
+
+b3 = 3b: 12 for G1 (b = 4), 12*(1+u) for the twist (b' = 4(1+u)) — free
+elementwise small-scalings in the lazy fp representation.
+
+Only affine outputs are compared bit-for-bit against the spec
+(`ops.curve.CurveOps`) — projective representatives are not canonical.
 
 Field genericity: each function takes `fl`, a field namespace (the `fp`
 module for G1 or the Fp2 shim below for G2), mirroring the spec's CurveOps
@@ -41,6 +52,18 @@ class _Fp2Field:
     def mul_small(a, k):
         return tw.fp2_mul_small(a, k)
 
+    @staticmethod
+    def mul_many(lhs, rhs):
+        """Stack independent Fp2 products into one base-field contraction."""
+        prods = tw.fp2_mul(tw._stack2(lhs), tw._stack2(rhs))
+        return tw._unstack2(prods, len(lhs))
+
+    @staticmethod
+    def b3(t):
+        # 3b' = 12(1+u): t*(1+u) is (c0-c1, c0+c1); then scale by 12 — all
+        # elementwise lazy ops
+        return tw.fp2_mul_small(tw.fp2_mul_xi(t), 12)
+
 
 class _FpField:
     add = staticmethod(fp.add)
@@ -53,6 +76,11 @@ class _FpField:
     eq = staticmethod(fp.eq)
     select = staticmethod(fp.select)
     mul_small = staticmethod(fp.mul_small)
+    mul_many = staticmethod(fp.mul_stack)
+
+    @staticmethod
+    def b3(t):
+        return fp.mul_small(t, 12)  # 3b = 12 (b = 4)
 
     @staticmethod
     def zeros(shape=()):
@@ -68,86 +96,56 @@ FP2 = _Fp2Field
 
 
 def jinfinity(fl, shape=()):
-    """The spec's identity encoding: (1, 1, 0) Jacobian (curve.py:98)."""
-    return (fl.ones(shape), fl.ones(shape), fl.zeros(shape))
+    """The projective identity (0 : 1 : 0)."""
+    return (fl.zeros(shape), fl.ones(shape), fl.zeros(shape))
 
 
-def jdouble(fl, j):
-    """Branchless Jacobian doubling (same formulas as spec curve.py:95-113;
-    Y == 0 or Z == 0 -> identity)."""
-    X, Y, Z = j
-    A = fl.sq(X)
-    B = fl.sq(Y)
-    C = fl.sq(B)
-    D = fl.sub(fl.sub(fl.sq(fl.add(X, B)), A), C)
-    D = fl.add(D, D)
-    E = fl.mul_small(A, 3)
-    F = fl.sq(E)
-    X3 = fl.sub(F, fl.add(D, D))
-    C8 = fl.mul_small(C, 8)
-    Y3 = fl.sub(fl.mul(E, fl.sub(D, X3)), C8)
-    Z3 = fl.mul(fl.add(Y, Y), Z)
-    bad = fl.is_zero(Z) | fl.is_zero(Y)
-    inf = jinfinity(fl, bad.shape)
+def jadd(fl, p, q):
+    """Complete projective addition (RCB 2015 Alg. 7, a = 0): 12 products
+    in 3 stacked multiplies, valid for all curve points incl. identity."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    t0, t1, t2, m3, m4, m5 = fl.mul_many(
+        [X1, Y1, Z1, fl.add(X1, Y1), fl.add(Y1, Z1), fl.add(X1, Z1)],
+        [X2, Y2, Z2, fl.add(X2, Y2), fl.add(Y2, Z2), fl.add(X2, Z2)],
+    )
+    t3 = fl.sub(fl.sub(m3, t0), t1)  # X1Y2 + X2Y1
+    t4 = fl.sub(fl.sub(m4, t1), t2)  # Y1Z2 + Y2Z1
+    t5 = fl.sub(fl.sub(m5, t0), t2)  # X1Z2 + X2Z1
+    b3t2 = fl.b3(t2)
+    y3 = fl.b3(t5)
+    t0_3 = fl.add(fl.add(t0, t0), t0)  # 3X1X2
+    z3s = fl.add(t1, b3t2)
+    t1m = fl.sub(t1, b3t2)
+    x3a, t2c, y3b, t1d, t0e, z3f = fl.mul_many(
+        [t4, t3, y3, t1m, t0_3, z3s],
+        [y3, t1m, t0_3, z3s, t3, t4],
+    )
     return (
-        fl.select(bad, inf[0], X3),
-        fl.select(bad, inf[1], Y3),
-        fl.select(bad, inf[2], Z3),
+        fl.sub(t2c, x3a),
+        fl.add(t1d, y3b),
+        fl.add(z3f, t0e),
     )
 
 
-def jadd(fl, j1, j2):
-    """Branchless Jacobian addition with all edge cases selected
-    (spec curve.py:115-143): identities, doubling, inverse pair."""
-    X1, Y1, Z1 = j1
-    X2, Y2, Z2 = j2
-    Z1Z1 = fl.sq(Z1)
-    Z2Z2 = fl.sq(Z2)
-    U1 = fl.mul(X1, Z2Z2)
-    U2 = fl.mul(X2, Z1Z1)
-    S1 = fl.mul(Y1, fl.mul(Z2, Z2Z2))
-    S2 = fl.mul(Y2, fl.mul(Z1, Z1Z1))
-    H = fl.sub(U2, U1)
-    I = fl.sq(fl.add(H, H))
-    J = fl.mul(H, I)
-    rr = fl.sub(S2, S1)
-    rr = fl.add(rr, rr)
-    V = fl.mul(U1, I)
-    X3 = fl.sub(fl.sub(fl.sq(rr), J), fl.add(V, V))
-    S1J = fl.mul(S1, J)
-    Y3 = fl.sub(fl.mul(rr, fl.sub(V, X3)), fl.add(S1J, S1J))
-    Z3 = fl.mul(fl.mul(Z1, Z2), H)
-    Z3 = fl.add(Z3, Z3)
-    res = (X3, Y3, Z3)
-
-    z1_zero = fl.is_zero(Z1)
-    z2_zero = fl.is_zero(Z2)
-    both = ~z1_zero & ~z2_zero
-    same_x = fl.is_zero(H) & both
-    same_y = fl.is_zero(rr)
-    dbl = jdouble(fl, j1)
-    inf = jinfinity(fl, z1_zero.shape)
-
-    def sel(r, d, i_, p_, q_):
-        out = fl.select(same_x & same_y, d, r)
-        out = fl.select(same_x & ~same_y, i_, out)
-        out = fl.select(z1_zero, q_, out)
-        out = fl.select(z2_zero & ~z1_zero, p_, out)
-        return out
-
-    return tuple(
-        sel(res[k], dbl[k], inf[k], j1[k], j2[k]) for k in range(3)
-    )
+def jdouble(fl, p):
+    """Complete projective doubling (RCB 2015 Alg. 9, a = 0): 9 products
+    in 3 stacked multiplies."""
+    X, Y, Z = p
+    a_, b_, c_, xy = fl.mul_many([Y, Y, Z, X], [Y, Z, Z, Y])
+    cb = fl.b3(c_)
+    e8 = fl.mul_small(a_, 8)
+    y3s = fl.add(a_, cb)
+    t0m = fl.sub(a_, fl.mul_small(cb, 3))
+    x3p, z3, y2m, x3m = fl.mul_many([cb, b_, t0m, t0m], [e8, e8, y3s, xy])
+    return (fl.add(x3m, x3m), fl.add(x3p, y2m), z3)
 
 
-def to_affine(fl, j):
-    """Jacobian -> (x, y, is_infinity-mask). Uses one field inversion."""
-    X, Y, Z = j
+def to_affine(fl, p):
+    """Projective -> (x, y, is_infinity-mask). Uses one field inversion."""
+    X, Y, Z = p
     zinv = fl.inv(Z)
-    zinv2 = fl.sq(zinv)
-    x = fl.mul(X, zinv2)
-    y = fl.mul(Y, fl.mul(zinv2, zinv))
-    return x, y, fl.is_zero(Z)
+    return fl.mul(X, zinv), fl.mul(Y, zinv), fl.is_zero(Z)
 
 
 def gather_point(table, idx):
@@ -157,26 +155,22 @@ def gather_point(table, idx):
 
 
 def affine_to_jacobian(fl, x, y, inf):
-    """Affine pytree + infinity mask -> Jacobian (identity = (1, 1, 0))."""
+    """Affine pytree + infinity mask -> projective ((x,y,1) / (0,1,0))."""
     one = fl.ones(inf.shape)
     zero = fl.zeros(inf.shape)
     return (
-        fl.select(inf, one, x),
+        fl.select(inf, zero, x),
         fl.select(inf, one, y),
         fl.select(inf, zero, one),
     )
 
 
 def build_tables_device(fl, x, y, inf):
-    """On-device per-point multiples 0..15 for the distinct-base MSM.
-
-    x, y: affine coordinate pytrees [..., k]; inf: bool [..., k].
-    Returns Jacobian pytree with leaves [..., k, 16, NLIMBS-ish] (a new axis
-    inserted before the limb dims). The 15 chained adds run as a `lax.scan`
-    so jadd is compiled ONCE (unrolled, this function alone was ~91k HLO
-    lines and dominated the combined-kernel compile); amortized over the
-    whole [..., k] batch, unlike the host-side spec-op tables of msm_shared
-    (those are only viable when the bases are shared by every batch row)."""
+    """On-device per-point projective multiples 0..15 for the windowed
+    MSMs. x, y: affine coordinate pytrees [..., k]; inf: bool [..., k].
+    Returns a pytree with leaves [..., k, 16, limbs...]. The 15 chained
+    complete adds run as a `lax.scan` so jadd is compiled ONCE; amortized
+    over the whole [..., k] batch."""
     jac = affine_to_jacobian(fl, x, y, inf)
 
     def body(prev, _):
@@ -189,17 +183,30 @@ def build_tables_device(fl, x, y, inf):
     )
 
 
-def fold_points(fl, pts, n, axis_offset=0):
-    """Sum a pytree of n points along its (axis_offset)-th leading axis by
-    pairwise halving: jadd(first half, second half), width n/2, n/4, ..., 1.
-
-    Total arithmetic is ~n-1 lane-adds — the minimum for a sum. (The earlier
-    fixed-width roll-butterfly kept every step at width n so jadd compiled
-    once, but that costs n*log2(n) lane-adds: 10x the FLOPs at n=1024. The
-    halving tree instantiates log2(n) differently-shaped jadds in HLO, which
-    compiles fine and is cached persistently.) n must be a power of two."""
+def fold_points(fl, pts, n, axis_offset=0, chunk=16):
+    """Sum a pytree of n (power of two) points along its (axis_offset)-th
+    leading axis with ~n-1 lane-adds (the minimum): a lax.scan over
+    chunk-size groups (jadd compiled ONCE at width n/chunk) followed by a
+    pairwise-halving unroll over the n/chunk partial sums (log2(n/chunk)
+    jadd shapes in HLO — small now that jadd is the complete-RCB form)."""
     assert n & (n - 1) == 0
     ax = axis_offset
+    if n > chunk:
+        g = n // chunk
+
+        def split(t):
+            s = t.shape
+            return jnp.moveaxis(
+                t.reshape(s[:ax] + (g, chunk) + s[ax + 1 :]), ax + 1, 0
+            )
+
+        xs = jax.tree_util.tree_map(split, pts)  # leaves [chunk, .. g ..]
+        init = jax.tree_util.tree_map(lambda t: t[0], xs)
+        rest = jax.tree_util.tree_map(lambda t: t[1:], xs)
+        pts = jax.lax.scan(
+            lambda c, x: (jadd(fl, c, x), None), init, rest
+        )[0]
+        n = g
     while n > 1:
         half = n // 2
         lo = jax.tree_util.tree_map(
@@ -220,7 +227,7 @@ def msm_distinct(fl, x, y, inf, digits):
 
     x, y, inf: affine points [..., k]; digits: uint [..., k, nwin] 4-bit
     windows, most significant first (zero scalars -> all-zero digits).
-    Returns a Jacobian accumulator pytree with leading dims [...]."""
+    Returns a projective accumulator pytree with leading dims [...]."""
     tables = build_tables_device(fl, x, y, inf)
     k = inf.shape[-1]
     acc = jinfinity(fl, inf.shape[:-1])
@@ -255,10 +262,10 @@ def msm_shared(fl, tables, digits):
     """Windowed shared-base MSM.
 
     tables: pytree (X, Y, Z) of arrays [k, 16, ...limbs...] — per-base
-      Jacobian multiples 0..15 (entry 0 = identity), precomputed host-side
-      from the spec ops so table contents are trusted.
+      projective multiples 0..15 (entry 0 = identity (0,1,0)), precomputed
+      host-side from the spec ops so table contents are trusted.
     digits: uint array [B, k, nwin] — 4-bit windows, most significant first.
-    Returns Jacobian accumulator pytree with leading [B].
+    Returns a projective accumulator pytree with leading [B].
 
     Compile-size discipline: the window loop is a `scan` and the doubling /
     per-base-add loops are `fori_loop`s, so jdouble and jadd are each

@@ -1,38 +1,53 @@
-"""Batched Fp (BLS12-381 base field) arithmetic on balanced 8-bit limbs in f32.
+"""Batched Fp (BLS12-381 base field) arithmetic on 52 lazy signed 8-bit
+limbs in f32 — the third redesign of SURVEY.md §7 hard part (a).
 
 Every function operates on arrays of shape [..., NLIMBS] (leading dims =
-batch). Elements are in the Montgomery domain (R = 2^384) in a REDUNDANT
-balanced representation:
+batch). Elements are in the Montgomery domain (R = 2^416) as SIGNED limb
+vectors:
 
-  value = sum_i limb_i * 256^i,  limb_i in [-135, 135],  value in [0, B_MAX)
+  value = sum_i limb_i * 256^i,  52 limbs, f32, |value| tracked by class.
 
-with B_MAX (~2p) chosen so B_MAX^2 <= R*p — Montgomery reduction stays valid
-without ever producing a canonical (< p) value. Canonicalization happens on
-the host (decode reduces mod p) and inside the exact predicates `eq` /
-`is_zero` only.
+R/p ~ 2^35 of headroom (52*8 = 416 bits vs the 381-bit p) buys LAZY
+REDUCTION: between Montgomery multiplies nothing is ever normalized.
 
-Why this representation (SURVEY.md §7 hard part (a), third redesign):
+Two element classes, maintained by construction (import asserts pin every
+bound the algebra relies on):
 
-  - schoolbook limb products run ON THE MXU: outer product (exact f32,
-    |products| <= 135^2 < 2^15), split into two balanced byte planes
-    (|.| <= 128, exact bf16), each contracted with a static 0/1 band matrix
-    via bf16 matmuls with exact f32 accumulation (sums of <= 48 terms).
-  - NO carry/borrow scans anywhere: balanced limbs converge under the
-    shift/round "light pass" (|limb| drops 256x per pass to a <= 130 fixed
-    band) with no 0xFF-chain plateau, unlike non-negative limbs which need
-    carry-lookahead — the previous design spent 75% of its HLO (and tens of
-    minutes of XLA compile time) on `lax.associative_scan` carry fixes.
-  - exact zero test without canonicalization: once |limb| <= 254, a nonzero
-    limb k dominates the lower tail (|sum_{i<k} limb_i 256^i| < 256^k), so
-    value == 0  <=>  every limb == 0 (downward induction). `eq`/`is_zero`
-    test the handful of multiples of p their bounded ranges allow.
-  - signed-carry safety: a light pass drops the carry out of the top buffer
-    limb, so every normalization that must preserve the full value runs in a
-    buffer extended by `_EXTRA` limbs; value bounds (commented per site)
-    prove the extension limbs end at zero — except where truncation mod
-    2^384 is intended (the two inner REDC normalizations).
+  NORMALIZED — mul outputs and encoded constants: |limbs| <= 132,
+    |value| <= V_NORM = 4p. Tail domination then forces limbs 50 and 51 to
+    be EXACTLY zero: |l51| <= (V_NORM + 132*(2^408-1)/255)/2^408 < 1, and
+    an integer below 1 is 0 (same for l50). Two vacant top limbs make the
+    carry passes inside `mul` value-exact: carries never fall off the top.
 
-The import-time asserts pin the exact bounds the algebra relies on.
+  LAZY — any +/-/small-constant combination of normalized values with
+    total limb weight <= 2^17/132 (~992 terms; the heaviest real call site
+    is the G2 complete-add b3 path at ~432 terms — t5 is a 9-term sum,
+    the twist's b3 = 12(1+u) scales it 24x componentwise, and the next
+    fp2_mul's Karatsuba a0+a1 doubles it):
+    |limbs| <= L_LAZY = 2^17, |value| <= V_LAZY = 1024p, l50 = l51 = 0
+    (sums of zeros stay zero).
+
+Consequences:
+  - add/sub/neg/mul_small are ELEMENTWISE f32 ops — one HLO instruction,
+    no carry chains, no masked subtractions. This is where the previous
+    (48-limb, eagerly-reduced) design spent most of its HLO size and VPU
+    time: each add ran a 3-pass normalize + 3 masked-subtract rounds.
+  - mul: two shift/round passes bring |limbs| <= 132 exactly (carries from
+    l49 land in the vacant l50/l51), then one-shot Montgomery REDC with a
+    signed m (|m| <= 0.64 R) — no nonnegativity fix-up term. Output value
+    bound: V_LAZY^2/R + 0.64p < 0.66p.
+  - schoolbook limb products run ON THE MXU: outer products (<= 132^2,
+    exact f32) split into two byte planes hi = floor((t+128)/256) in
+    [-69, 69] and lo = t - 256*hi in [-128, 127], each contracted against a
+    static 0/1 band matrix as int8 x int8 -> int32 matmuls (native int8
+    MXU peak is 2x bf16 on v5e; every sum of <= 52 terms is exact in both
+    int32 and the bf16->f32 fallback, COCONUT_FP_INT8=0).
+  - exact predicates COMPRESS first (one Montgomery mul by the encoded 1):
+    the result is normalized with |value| < 0.66p < p, so value == 0 mod p
+    iff value == 0 iff every limb is 0 (downward domination at |l| <= 132).
+
+Kept bit-identical to the pure-Python spec (`coconut_tpu.ops.fields`) at
+the decode boundary: limbs.fp_decode reduces the signed value mod p.
 """
 
 import os
@@ -47,42 +62,41 @@ from .limbs import MONT_R, NLIMBS, balanced_limbs
 
 # --- bounds (exact integer arithmetic at import time) -----------------------
 
-# Top estimate uses limbs 46..48: s = l48*2^16 + l47*2^8 + l46 approximates
-# value/2^368 with error |tail| <= TAIL (the 46 lower balanced limbs).
-_TAIL = 135 * ((256**46 - 1) // 255)
-# masked subtract of 2p is safe (value certainly >= 2p) when s >= THRESH:
-_THRESH = (2 * P + _TAIL) // (1 << (8 * 46)) + 1
-# and a value that misses the test is certainly below B_MAX:
-B_MAX = _THRESH * (1 << (8 * 46)) + _TAIL
+L_NORM = 132            # normalized limb bound
+V_NORM = 4 * P          # normalized value bound
+L_LAZY = 1 << 17        # lazy limb bound (mul-input cap)
+V_LAZY = 1024 * P       # lazy value bound (mul-input cap)
 
-assert _THRESH * (1 << (8 * 46)) - _TAIL >= 2 * P  # safety of the subtract
-assert B_MAX * B_MAX <= MONT_R * P  # Montgomery reduction valid
-# mul output bound: t/R + |m|*p/R + p  with |m| <= 0.51*2^384:
-assert B_MAX * B_MAX // MONT_R + P * 51 // 100 + P + 4 < B_MAX
-# add/sub enter _reduce with value < max(2*B_MAX, B_MAX + 4p); each masked
-# round either certifies value < B_MAX (miss, by construction of B_MAX) or
-# subtracts 2p; three rounds therefore always land below B_MAX:
-assert 2 * B_MAX - 6 * P < B_MAX and B_MAX + 4 * P - 6 * P < B_MAX
-# slicing the 4p constant to 48 limbs must not drop a top carry:
-assert all(v == 0.0 for v in balanced_limbs(4 * P, NLIMBS + 1)[NLIMBS:])
+_TAIL50 = L_NORM * ((256**50 - 1) // 255)
+_TAIL51 = L_NORM * ((256**51 - 1) // 255)
+# top-limb vacancy of normalized values: l50 = l51 = 0 exactly
+assert V_NORM + _TAIL50 < 256**50
+assert V_NORM + _TAIL51 < 256**51
+# two passes on lazy limbs: pass1 <= 128 + ceil(L_LAZY/256) = 640;
+# pass2 <= 128 + 3 = 131 <= L_NORM. Carries land in the vacant top limbs:
+# pass1 puts <= 512 in l50, pass2 puts <= 2 in l51, carry out of l51 is 0.
+_P1 = 128 + (L_LAZY + 128) // 256
+assert 128 + (_P1 + 128) // 256 <= L_NORM
+assert (_P1 + 128) // 256 < 128  # l51 stays far below a further carry
+# byte planes exact in int8: |t| <= 132^2 => hi in [-69,69], lo in [-128,127]
+assert L_NORM * L_NORM <= 127 * 256 + 127
+# school coefficients: sums of <= 52 products, exact f32/int32
+assert NLIMBS * L_NORM * L_NORM < 1 << 24
+# REDC: |m| <= 0.64 R (m limbs <= 132 after 3 passes: 132*256/255/256 < 0.52,
+# use 0.64 for slack); |out| <= V_LAZY^2/R + 0.64p < 0.66p < V_NORM
+assert V_LAZY * V_LAZY // MONT_R + 64 * P // 100 + 1 < 2 * P // 3
+# mul-internal coefficient bound (t + m*p): < 2^22, exact f32 adds
+assert NLIMBS * L_NORM * L_NORM * 2 < 1 << 22
 
 _BASE = 256.0
 _INV_BASE = 1.0 / 256.0
-_EXTRA = 3  # buffer headroom: carries travel <= 1 limb per pass, 3 passes
 
-_P2_J = jnp.asarray(balanced_limbs(2 * P, NLIMBS + _EXTRA), dtype=jnp.float32)
 _P_BAL_J = jnp.asarray(balanced_limbs(P), dtype=jnp.float32)
 _NPRIME_J = jnp.asarray(
     balanced_limbs((-pow(P, -1, MONT_R)) % MONT_R, wrap=True),
     dtype=jnp.float32,
 )
 _ONE_M_J = jnp.asarray(balanced_limbs(MONT_R % P), dtype=jnp.float32)
-# candidate multiples of p for the exact predicates (49-limb buffers: 5p..6p
-# exceed what 48 balanced limbs can represent)
-_PK_J = [
-    jnp.asarray(balanced_limbs(k * P, NLIMBS + 1), dtype=jnp.float32)
-    for k in range(7)
-]
 
 # Static band matrix: BAND[i*NLIMBS + j, k] = 1 iff i + j == k.
 _BAND_NP = np.zeros((NLIMBS * NLIMBS, 2 * NLIMBS - 1), dtype=np.float32)
@@ -91,75 +105,61 @@ for _i in range(NLIMBS):
         _BAND_NP[_i * NLIMBS + _j, _i + _j] = 1.0
 _BAND = jnp.asarray(_BAND_NP, dtype=jnp.bfloat16)
 _BAND_I8 = jnp.asarray(_BAND_NP, dtype=jnp.int8)
-
-# int8 MXU path (default): the same two byte planes contracted as
-# int8 x int8 -> int32 matmuls — native int8 MXU peak is 2x bf16 on v5e and
-# every intermediate is still exact (planes in [-128, 127] by the floor
-# split; band sums <= 48*128 < 2^31). COCONUT_FP_INT8=0 falls back to bf16.
 _USE_INT8 = os.environ.get("COCONUT_FP_INT8", "1") == "1"
 
 
 def _school(a, b, out_len):
     """Polynomial limb product c_k = sum_{i+j=k} a_i * b_j, truncated to
-    out_len limbs. |a_i|,|b_j| <= 135: outer products <= 135^2 < 2^15 (exact
-    f32); split into two byte planes with hi = floor((t+128)/256), so
-    lo = t - 256*hi in [-128, 127] and |hi| <= 72 — both exact in int8/bf16;
-    band sums of <= 48 terms accumulate exactly in int32/f32 on the MXU;
-    recombined coefficients <= 48*135^2 < 2^20 (exact f32)."""
+    out_len limbs. Inputs |a_i|,|b_j| <= 132 (see import asserts)."""
     outer = a[..., :, None] * b[..., None, :]
     flat = outer.reshape(outer.shape[:-2] + (NLIMBS * NLIMBS,))
     hi = jnp.floor((flat + 128.0) * _INV_BASE)
     lo = flat - hi * _BASE
     if _USE_INT8:
-        band = _BAND_I8[:, :out_len]
         acc_lo = jnp.einsum(
             "...x,xk->...k",
             lo.astype(jnp.int8),
-            band,
+            _BAND_I8[:, :out_len],
             preferred_element_type=jnp.int32,
         )
         acc_hi = jnp.einsum(
             "...x,xk->...k",
             hi.astype(jnp.int8),
-            band,
+            _BAND_I8[:, :out_len],
             preferred_element_type=jnp.int32,
         )
         return (acc_lo + acc_hi * 256).astype(jnp.float32)
-    band = _BAND[:, :out_len]
     acc_lo = jnp.einsum(
         "...x,xk->...k",
         lo.astype(jnp.bfloat16),
-        band,
+        _BAND[:, :out_len],
         preferred_element_type=jnp.float32,
     )
     acc_hi = jnp.einsum(
         "...x,xk->...k",
         hi.astype(jnp.bfloat16),
-        band,
+        _BAND[:, :out_len],
         preferred_element_type=jnp.float32,
     )
     return acc_lo + acc_hi * _BASE
 
 
 def _shift_up(hi):
-    """Move per-limb carries one limb up. Drops the top limb's carry —
-    callers either extend the buffer (value-preserving sites) or intend
-    truncation mod 2^(8*buflen) (the inner REDC sites)."""
+    """Move per-limb carries one limb up (drops the top limb's carry —
+    exact at every call site by the vacancy/zero-coefficient arguments in
+    `mul`, or truncation mod 2^416 is intended)."""
     return jnp.concatenate([jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
 
 
 def _pass(t):
-    """One balanced shift/round pass: exact (power-of-two scalings and
-    integer adds below 2^24), |limb| drops 256x toward the <= 130 band."""
+    """One shift/round carry pass: exact power-of-two scalings and integer
+    adds below 2^24; |limb| drops ~256x toward the <= 132 band."""
     hi = jnp.round(t * _INV_BASE)
     lo = t - hi * _BASE
     return lo + _shift_up(hi)
 
 
-def _norm(t, passes=3):
-    """|limbs| < 2^21 -> |limbs| <= 130 (value preserved up to top-limb
-    truncation; see _shift_up). Pass bounds: 2^21 -> 128+2^13 -> 128+33 ->
-    128+2."""
+def _norm(t, passes):
     for _ in range(passes):
         t = _pass(t)
     return t
@@ -169,28 +169,6 @@ def _ext(t, extra):
     return jnp.concatenate(
         [t, jnp.zeros(t.shape[:-1] + (extra,), dtype=jnp.float32)], axis=-1
     )
-
-
-def _top_estimate(t):
-    """s ~= value/2^368 from limbs 46..48 (post-_norm: |l48| <= 1 whenever
-    value < 2^384, so |s| < 2^17 — exact f32)."""
-    return (
-        t[..., NLIMBS] * 65536.0
-        + t[..., NLIMBS - 1] * _BASE
-        + t[..., NLIMBS - 2]
-    )
-
-
-def _reduce(t):
-    """Post-add/sub reduction in an extended buffer: value < 2*B_MAX + 4p ->
-    value < B_MAX, |limbs| <= 130, sliced back to 48 limbs (value < B_MAX
-    < 2^383 forces the extension limbs to zero)."""
-    t = _norm(_ext(t, _EXTRA))
-    for _ in range(3):
-        mask = _top_estimate(t) >= float(_THRESH)
-        t = t - jnp.where(mask[..., None], _P2_J, 0.0)
-        t = _pass(t)
-    return t[..., :NLIMBS]
 
 
 # --- public ops -------------------------------------------------------------
@@ -205,57 +183,53 @@ def ones_mont(shape=()):
 
 
 def add(a, b):
-    return _reduce(a + b)  # |limbs| <= 270; value < 2*B_MAX
+    return a + b
 
 
 def sub(a, b):
-    # +4p keeps the value positive (B_MAX < 4p); range (4p-B_MAX, B_MAX+4p)
-    return _reduce(a - b + _PK_J[4][..., :NLIMBS])
+    return a - b
 
 
 def neg(a):
-    return _reduce(_PK_J[4][..., :NLIMBS] - a)
+    return -a
+
+
+def mul_small(a, k):
+    """a * k for small static nonnegative k — elementwise (lazy)."""
+    if k == 0:
+        return jnp.zeros_like(a)
+    if k == 1:
+        return a
+    return a * float(k)
 
 
 def mul(a, b):
-    """Montgomery product a * b * 2^-384 mod p; values < B_MAX in/out.
+    """Montgomery product a * b * 2^-416 mod p. Inputs LAZY (|limbs| <=
+    2^15, |value| <= 1024p, top two limbs zero), output NORMALIZED
+    (|limbs| <= 132, |value| < 0.66p).
 
-    REDC with balanced m: t = a*b; m = (t mod 2^384)*N' mod 2^384 (balanced,
-    |m| <= 0.51*2^384 < R); result = (t + m*p + p*R)/2^384 — the p*R term
-    keeps the numerator nonnegative despite m's sign (it adds p, still 0
-    mod p, to the quotient). Output < B_MAX^2/R^2*... see import asserts."""
-    t = _school(a, b, 2 * NLIMBS - 1)  # |limbs| < 2^20
-    tlo = _norm(t[..., :NLIMBS])  # t mod 2^384 (truncation intended)
-    m = _norm(_school(tlo, _NPRIME_J, NLIMBS))  # |value| <= 0.51*2^384
-    u = _school(m, _P_BAL_J, 2 * NLIMBS - 1)  # m*p, |limbs| < 2^20
-    w = t + u  # |limbs| < 2^21; value = t + m*p, divisible by 2^384
-    # Low half in a value-preserving extended buffer: after _norm the limbs
-    # [0:48] are exactly zero (value divisible by 2^384, |limbs| <= 130 —
-    # upward induction mod 256), and [48:51] hold the carry into the high
-    # half (|carry| = |w_lo|/2^384 <= 2^21*2^377/2^384 < 2^15).
-    lo = _norm(_ext(w[..., :NLIMBS], _EXTRA))
-    hi = _ext(w[..., NLIMBS:], 1)  # 47 -> 48 limbs
-    hi = hi + _P_BAL_J  # the +p*R quotient term (nonnegativity)
-    hi = hi.at[..., : _EXTRA].add(lo[..., NLIMBS : NLIMBS + _EXTRA])
-    # value < B_MAX^2/R + 0.51p + p < 2.6p < B_MAX (import assert): the
-    # extension limbs normalize to zero, slice back.
-    return _norm(_ext(hi, _EXTRA))[..., :NLIMBS]
+    Signed one-shot REDC: t = a*b; m = (t mod 2^416)*N' mod 2^416 (signed,
+    |m| <= 0.64 R); u = (t + m*p) / 2^416 — exact division, no
+    nonnegativity term needed (values may be negative)."""
+    a1 = _norm(a, 2)  # |limbs| <= 132; carries land in vacant l50/l51
+    b1 = _norm(b, 2)
+    t = _school(a1, b1, 2 * NLIMBS - 1)  # |coeff| < 2^21
+    tlo = _norm(t[..., :NLIMBS], 3)  # t mod 2^416 (truncation intended)
+    m = _norm(_school(tlo, _NPRIME_J, NLIMBS), 3)  # signed, trunc mod 2^416
+    w = t + _school(m, _P_BAL_J, 2 * NLIMBS - 1)  # = t + m*p, |coeff| < 2^22
+    # Low half: value divisible by 2^416 and |coeffs| normalized => limbs
+    # [0:52] end exactly zero; the carry into the high half sits in the
+    # extension limbs (|carry| <= 2^14, fits 3 limbs).
+    lo = _norm(_ext(w[..., :NLIMBS], 3), 3)
+    hi = _ext(w[..., NLIMBS:], 1)  # 51 -> 52 limbs
+    hi = hi.at[..., :3].add(lo[..., NLIMBS : NLIMBS + 3])
+    # w's nonzero coefficients stop by index 102 (inputs have l50=l51~0),
+    # so the high half's top limbs stay small: 3 passes normalize exactly.
+    return _norm(hi, 3)
 
 
 def sq(a):
     return mul(a, a)
-
-
-def mul_small(a, k):
-    """a * k for tiny static k (2..12) via an addition chain (each add
-    re-reduces, keeping the value < B_MAX)."""
-    if k == 0:
-        return zeros_like(a)
-    if k == 1:
-        return a
-    half = mul_small(a, k // 2)
-    dbl = add(half, half)
-    return add(dbl, a) if k & 1 else dbl
 
 
 def pow_static(a, e):
@@ -279,35 +253,27 @@ def inv(a):
     return pow_static(a, P - 2)
 
 
-# --- exact predicates -------------------------------------------------------
-
-
-def _is_zero_value(t):
-    """t in a 49-limb buffer, |limbs| <= 131 after _norm: value == 0 <=>
-    all limbs zero (a nonzero limb dominates the balanced tail below it)."""
-    return jnp.all(t == 0.0, axis=-1)
-
-
-def _is_multiple_of_p(t49, kmin, kmax):
-    """t49: 49-limb normalized buffer, value in (kmin*p - p, (kmax+1)*p):
-    test value == k*p for k in [kmin, kmax]."""
-    bits = None
-    for k in range(kmin, kmax + 1):
-        b = _is_zero_value(_norm(t49 - _PK_J[k], passes=2))
-        bits = b if bits is None else (bits | b)
-    return bits
+# --- exact predicates (compress, then all-limbs-zero) -----------------------
 
 
 def is_zero(a):
-    """a == 0 mod p (value in [0, B_MAX) => candidates {0, p, 2p})."""
-    return _is_multiple_of_p(_norm(_ext(a, 1), passes=1), 0, 2)
+    """a == 0 mod p for any LAZY a: one Montgomery mul by the encoded 1
+    compresses to a normalized value with |value| < p, which is 0 mod p
+    iff it is 0 iff every limb is 0 (downward domination)."""
+    c = mul(a, ones_mont(a.shape[:-1]))
+    return jnp.all(c == 0.0, axis=-1)
+
+
+def is_zero_many(vals):
+    """[v, ...] -> [v == 0 mod p, ...] with ALL the compress-muls stacked
+    into one MXU contraction (the tower predicates' batching lever)."""
+    ones = ones_mont(vals[0].shape[:-1])
+    outs = mul_stack(vals, [ones] * len(vals))
+    return [jnp.all(o == 0.0, axis=-1) for o in outs]
 
 
 def eq(a, b):
-    """a == b mod p. d = a - b + 4p is in (4p - B_MAX, 4p + B_MAX) subset
-    (p, 7p): candidates 2p..6p (1..6 kept for margin)."""
-    d = _norm(_ext(a - b, 1) + _PK_J[4], passes=2)
-    return _is_multiple_of_p(d, 1, 6)
+    return is_zero(a - b)
 
 
 def select(mask, a, b):
@@ -320,9 +286,8 @@ def select(mask, a, b):
 
 def mul_stack(lhs_list, rhs_list):
     """Stack S independent products into ONE mul: [(a, b), ...] with shared
-    leading dims -> list of S products. Collapses the extension-tower's many
-    base-field multiplies into a single MXU contraction (compile-size and
-    MXU-utilization win; see tower.py)."""
+    leading dims -> list of S products. Collapses tower/curve formulas'
+    many base-field multiplies into a single MXU contraction."""
     L = jnp.stack(jnp.broadcast_arrays(*lhs_list), axis=-2)  # [..., S, N]
     Rv = jnp.stack(jnp.broadcast_arrays(*rhs_list), axis=-2)
     out = mul(L, Rv)

@@ -1,19 +1,21 @@
-"""Limb codec: python ints <-> 48 x 8-bit limbs in float32 lanes.
+"""Limb codec: python ints <-> 52 x 8-bit limbs in float32 lanes.
 
 The limb decomposition is the host<->device wire format for all field
 elements (SURVEY.md §7 stage 6 "limb codec"). 8-bit BALANCED limbs (each in
 [-128, 128]) in float32 were chosen so the schoolbook limb products run on
-the MXU: products split into two exact bf16 byte planes, contracted against
-a static 0/1 band matrix with float32 accumulation — every intermediate is
-an integer below 2^24 and therefore EXACT in float32 (the systolic array
-becomes a bignum multiplier), and balanced carries normalize in a fixed
-number of shift/round passes with no carry-lookahead scans (see tpu/fp.py).
-This replaced a 16-bit-limbs-in-uint64 design whose emulated 64-bit VPU ops
-were ~70x slower and whose per-op HLO count made XLA compiles take tens of
-minutes.
+the MXU: products split into two exact int8/bf16 byte planes, contracted
+against a static 0/1 band matrix with int32/f32 accumulation — every
+intermediate is an integer below 2^24 and therefore EXACT (the systolic
+array becomes a bignum multiplier). 52 limbs (416 bits, vs the 381-bit p)
+buy ~2^35 of headroom so the device arithmetic can be LAZY: add/sub/neg
+and small-constant scalings are single elementwise ops, with all carry
+handling confined to the Montgomery multiply (see tpu/fp.py). This
+replaced (1) a 16-bit-limbs-in-uint64 design whose emulated 64-bit VPU ops
+were ~70x slower, and (2) a 48-limb eagerly-reduced design whose per-add
+normalize/subtract pipelines dominated both XLA compile time and VPU time.
 
 Least-significant limb first. Fp values travel in the Montgomery domain
-(a * 2^384 mod p) between kernels; encode/decode converts at the boundary so
+(a * 2^416 mod p) between kernels; encode/decode converts at the boundary so
 results are bit-identical to the pure-Python spec (`coconut_tpu.ops.fields`).
 """
 
@@ -22,9 +24,9 @@ import numpy as np
 from ..ops.fields import P, R
 
 LIMB_BITS = 8
-NLIMBS = 48  # 48 * 8 = 384 bits >= 381
+NLIMBS = 52  # 52 * 8 = 416 bits: ~2^35 of headroom over the 381-bit p
 MASK = (1 << LIMB_BITS) - 1
-MONT_BITS = LIMB_BITS * NLIMBS  # 384
+MONT_BITS = LIMB_BITS * NLIMBS  # 416
 MONT_R = 1 << MONT_BITS
 
 DTYPE = np.float32
@@ -75,7 +77,7 @@ def balanced_limbs(x, nlimbs=NLIMBS, wrap=False):
     """Nonnegative int -> balanced signed limbs (each in [-128, 128]) as
     np.float32[nlimbs]. The device representation: see tpu/fp.py. With
     `wrap`, a final carry is dropped (value taken mod 2^(8*nlimbs) — for
-    constants only used in mod-2^384 arithmetic, e.g. N')."""
+    constants only used in mod-2^416 arithmetic, e.g. N')."""
     digs = [(x >> (LIMB_BITS * i)) & MASK for i in range(nlimbs)]
     if x >> (LIMB_BITS * nlimbs):
         raise ValueError("value out of range for %d limbs" % nlimbs)
@@ -97,7 +99,7 @@ def balanced_limbs(x, nlimbs=NLIMBS, wrap=False):
 # --- Montgomery constants ---------------------------------------------------
 
 P_LIMBS = int_to_limbs(P)
-# N' = -p^{-1} mod 2^384, full width (for the one-shot Montgomery m)
+# N' = -p^{-1} mod 2^416, full width (for the one-shot Montgomery m)
 NPRIME = int_to_limbs((-pow(P, -1, MONT_R)) % MONT_R)
 # R^2 mod p: multiply by this (Montgomery-mul) to enter the domain
 R2 = int_to_limbs(MONT_R * MONT_R % P)
@@ -119,7 +121,7 @@ def fp_decode(limbs):
 def balanced_limbs_batch(xs, nlimbs=NLIMBS):
     """List of nonnegative ints -> np.float32[n, nlimbs] balanced limbs.
     Vectorized over the batch: the 0/1 balance carry propagates through one
-    48-step numpy loop instead of a Python loop per element."""
+    numpy loop over the limb axis instead of a Python loop per element."""
     buf = b"".join(int(x).to_bytes(nlimbs, "little") for x in xs)
     d = np.frombuffer(buf, dtype=np.uint8).reshape(-1, nlimbs).astype(np.int32)
     c = np.zeros(len(xs), dtype=np.int32)

@@ -9,7 +9,7 @@ Compile-size/MXU design: every tower multiply bottoms out in ONE stacked
 base-field multiply (`fp.mul_stack`) — fp2_mul stacks its 3 Karatsuba
 products, fp6_mul stacks its 6 fp2 products (-> 18 base lanes), fp12_mul its
 3 fp6 products (-> 54 base lanes). One Fp12 multiply is therefore a single
-[.., 54, 48] MXU contraction instead of 54 separate multiplies: ~50x fewer
+[.., 54, 52] MXU contraction instead of 54 separate multiplies: ~50x fewer
 HLO ops (XLA compile time) and far better systolic-array occupancy.
 
 Also provides the sparse Fp12 x line multiplication for the Miller loop
@@ -146,11 +146,13 @@ def fp2_inv(a):
 
 
 def fp2_is_zero(a):
-    return fp.is_zero(a[0]) & fp.is_zero(a[1])
+    z0, z1 = fp.is_zero_many([a[0], a[1]])
+    return z0 & z1
 
 
 def fp2_eq(a, b):
-    return fp.eq(a[0], b[0]) & fp.eq(a[1], b[1])
+    z0, z1 = fp.is_zero_many([fp.sub(a[0], b[0]), fp.sub(a[1], b[1])])
+    return z0 & z1
 
 
 def fp2_select(mask, a, b):
@@ -375,9 +377,12 @@ def fp12_ones(shape=()):
 
 def fp12_is_one(a):
     """Exact componentwise test against the Montgomery one (values are
-    redundant — fp.eq/is_zero do the exact mod-p comparison)."""
+    redundant — the compress-based predicates do the exact mod-p
+    comparison), all 12 compress-muls stacked into one contraction."""
     comps = jax.tree_util.tree_leaves(a)  # 12 Fp components, c0.c0.c0 first
-    bits = fp.eq(comps[0], fp.ones_mont(comps[0].shape[:-1]))
-    for x in comps[1:]:
-        bits = bits & fp.is_zero(x)
+    diffs = [fp.sub(comps[0], fp.ones_mont(comps[0].shape[:-1]))] + comps[1:]
+    zs = fp.is_zero_many(diffs)
+    bits = zs[0]
+    for z in zs[1:]:
+        bits = bits & z
     return bits
