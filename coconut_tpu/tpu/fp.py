@@ -112,36 +112,44 @@ def _school(a, b, out_len):
     """Polynomial limb product c_k = sum_{i+j=k} a_i * b_j, truncated to
     out_len limbs. Inputs |a_i|,|b_j| <= 132 (see import asserts)."""
     outer = a[..., :, None] * b[..., None, :]
-    flat = outer.reshape(outer.shape[:-2] + (NLIMBS * NLIMBS,))
+    lead = outer.shape[:-2]
+    # Collapse ALL leading dims to one before the contraction: the axon TPU
+    # backend miscompiles int8 dot_generals with multi-dim einsum batches
+    # when several such contractions fuse in one program (observed as
+    # wrong results in exactly one column of a [B, 2, ...] batch at
+    # B >= 256; a 2-D [N, x] @ [x, k] matmul is always correct).
+    flat = outer.reshape((-1, NLIMBS * NLIMBS))
+    if _USE_INT8:
+        # byte-plane split in integer arithmetic (f32 products are exact
+        # ints < 2^24; >> is an arithmetic shift, i.e. floor division)
+        flat_i = flat.astype(jnp.int32)
+        hi_i = (flat_i + 128) >> 8
+        lo_i = flat_i - (hi_i << 8)
+        acc_lo = jnp.dot(
+            lo_i.astype(jnp.int8),
+            _BAND_I8[:, :out_len],
+            preferred_element_type=jnp.int32,
+        )
+        acc_hi = jnp.dot(
+            hi_i.astype(jnp.int8),
+            _BAND_I8[:, :out_len],
+            preferred_element_type=jnp.int32,
+        )
+        out = (acc_lo + acc_hi * 256).astype(jnp.float32)
+        return out.reshape(lead + (out_len,))
     hi = jnp.floor((flat + 128.0) * _INV_BASE)
     lo = flat - hi * _BASE
-    if _USE_INT8:
-        acc_lo = jnp.einsum(
-            "...x,xk->...k",
-            lo.astype(jnp.int8),
-            _BAND_I8[:, :out_len],
-            preferred_element_type=jnp.int32,
-        )
-        acc_hi = jnp.einsum(
-            "...x,xk->...k",
-            hi.astype(jnp.int8),
-            _BAND_I8[:, :out_len],
-            preferred_element_type=jnp.int32,
-        )
-        return (acc_lo + acc_hi * 256).astype(jnp.float32)
-    acc_lo = jnp.einsum(
-        "...x,xk->...k",
+    acc_lo = jnp.dot(
         lo.astype(jnp.bfloat16),
         _BAND[:, :out_len],
         preferred_element_type=jnp.float32,
     )
-    acc_hi = jnp.einsum(
-        "...x,xk->...k",
+    acc_hi = jnp.dot(
         hi.astype(jnp.bfloat16),
         _BAND[:, :out_len],
         preferred_element_type=jnp.float32,
     )
-    return acc_lo + acc_hi * _BASE
+    return (acc_lo + acc_hi * _BASE).reshape(lead + (out_len,))
 
 
 def _shift_up(hi):
@@ -205,8 +213,8 @@ def mul_small(a, k):
 
 def mul(a, b):
     """Montgomery product a * b * 2^-416 mod p. Inputs LAZY (|limbs| <=
-    2^15, |value| <= 1024p, top two limbs zero), output NORMALIZED
-    (|limbs| <= 132, |value| < 0.66p).
+    L_LAZY = 2^17, |value| <= V_LAZY = 1024p, top two limbs zero), output
+    NORMALIZED (|limbs| <= 132, |value| < 0.66p).
 
     Signed one-shot REDC: t = a*b; m = (t mod 2^416)*N' mod 2^416 (signed,
     |m| <= 0.64 R); u = (t + m*p) / 2^416 — exact division, no
