@@ -218,7 +218,15 @@ def mul(a, b):
 
     Signed one-shot REDC: t = a*b; m = (t mod 2^416)*N' mod 2^416 (signed,
     |m| <= 0.64 R); u = (t + m*p) / 2^416 — exact division, no
-    nonnegativity term needed (values may be negative)."""
+    nonnegativity term needed (values may be negative).
+
+    On TPU the whole pipeline runs as one fused Pallas kernel
+    (pallas_fp.py) so no intermediate ever touches HBM; the XLA
+    formulation below is the CPU/fallback path (bit-identical)."""
+    from . import pallas_fp
+
+    if pallas_fp.enabled():
+        return pallas_fp.mul(a, b)
     a1 = _norm(a, 2)  # |limbs| <= 132; carries land in vacant l50/l51
     b1 = _norm(b, 2)
     t = _school(a1, b1, 2 * NLIMBS - 1)  # |coeff| < 2^21

@@ -1,0 +1,206 @@
+"""Fused Pallas TPU kernel for the Montgomery multiply (fp.mul).
+
+Why: the XLA formulation of `fp.mul` materializes the schoolbook outer
+product (a 52x data expansion, [N, 2704] f32) plus its two byte planes in
+HBM for every multiply — measured to make every kernel HBM-bound. This
+kernel keeps the whole REDC pipeline (input carry passes, three band
+contractions, low-half carry extraction, output normalization) in VMEM:
+per lane only 104 input + 52 output limbs cross HBM, and the three
+byte-plane matmul pairs run back-to-back on the MXU.
+
+Layout: everything TRANSPOSED to [limbs, lanes] — the lane (batch) axis
+sits in the 128-wide vector lanes, so the carry shift (`_shift_up`) is a
+static concatenate on the sublane axis, and the band contraction is
+[out_len, 2704] @ [2704, TN] with the batch in the minor dimension.
+
+The arithmetic is the same proof-carrying pipeline as fp.mul (see fp.py's
+import asserts): inputs LAZY (|limbs| <= 2^17, top two limbs vacant),
+output NORMALIZED (|limbs| <= 132, |value| < 0.66p), results bit-identical
+to the XLA path (differential-tested).
+
+Enabled automatically when the default JAX backend is a TPU (CPU tests
+keep the pure-XLA path), or forced via COCONUT_FP_PALLAS=1/0.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import fp as _fp
+from .limbs import NLIMBS
+
+TN = int(os.environ.get("COCONUT_PALLAS_TN", "256"))  # lanes per grid block
+# int8 MXU planes by default; COCONUT_FP_INT8=0 (the documented knob) or
+# COCONUT_PALLAS_I8=0 selects the bf16 fallback
+_I8 = (
+    os.environ.get("COCONUT_PALLAS_I8", os.environ.get("COCONUT_FP_INT8", "1"))
+    == "1"
+)
+
+_OUT2 = 2 * NLIMBS - 1  # 103
+
+# All Montgomery constants and the band structure are shared with fp.py so
+# the two paths can never desynchronize (fp imports this module lazily
+# inside mul, so there is no import cycle).
+_BAND_T = jnp.asarray(_fp._BAND_NP.T.copy(), dtype=jnp.bfloat16)
+_NPRIME_COL = np.asarray(_fp._NPRIME_J).reshape(NLIMBS, 1)
+_P_COL = np.asarray(_fp._P_BAL_J).reshape(NLIMBS, 1)
+_NPRIME_COL_J = jnp.asarray(_NPRIME_COL)
+_P_COL_J = jnp.asarray(_P_COL)
+
+_BASE = 256.0
+_INV_BASE = 1.0 / 256.0
+
+
+def _shift_up(h):
+    """Carry shift on the sublane (limb) axis: drop top, prepend zero."""
+    return jnp.concatenate([jnp.zeros_like(h[:1]), h[:-1]], axis=0)
+
+
+def _pass(t):
+    hi = jnp.round(t * _INV_BASE)
+    lo = t - hi * _BASE
+    return lo + _shift_up(hi)
+
+
+def _norm(t, passes):
+    for _ in range(passes):
+        t = _pass(t)
+    return t
+
+
+def _ext(t, extra):
+    return jnp.concatenate(
+        [t, jnp.zeros((extra, t.shape[1]), dtype=t.dtype)], axis=0
+    )
+
+
+def _mul_kernel(a_ref, b_ref, band_ref, np_ref, p_ref, out_ref):
+    a = _norm(a_ref[:], 2)  # [52, TN], |limbs| <= 132
+    b = _norm(b_ref[:], 2)
+
+    def school(x, y, out_len):
+        # outer[i, j, :] = x[i, :] * y[j, :] -> band-sum over i + j == k
+        outer = x[:, None, :] * y[None, :, :]
+        flat = outer.reshape(NLIMBS * NLIMBS, x.shape[1])
+        band = band_ref[:out_len, :]
+        if _I8:
+            flat_i = flat.astype(jnp.int32)
+            hi_i = (flat_i + 128) >> 8
+            lo_i = flat_i - (hi_i << 8)
+            acc_lo = jax.lax.dot_general(
+                band.astype(jnp.int8),
+                lo_i.astype(jnp.int8),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            acc_hi = jax.lax.dot_general(
+                band.astype(jnp.int8),
+                hi_i.astype(jnp.int8),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            return (acc_lo + acc_hi * 256).astype(jnp.float32)
+        hi = jnp.floor((flat + 128.0) * _INV_BASE)
+        lo = flat - hi * _BASE
+        acc_lo = jax.lax.dot_general(
+            band,
+            lo.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_hi = jax.lax.dot_general(
+            band,
+            hi.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_lo + acc_hi * _BASE
+
+    t = school(a, b, _OUT2)  # [103, TN]
+    tlo = _norm(t[:NLIMBS], 3)  # t mod 2^416 (truncation intended)
+    nprime = jnp.broadcast_to(np_ref[:], a.shape)
+    m = _norm(school(tlo, nprime, NLIMBS), 3)
+    pcol = jnp.broadcast_to(p_ref[:], a.shape)
+    w = t + school(m, pcol, _OUT2)  # = t + m*p
+    lo52 = _norm(_ext(w[:NLIMBS], 3), 3)  # limbs 0..51 -> 0, carry above
+    hi = _ext(w[NLIMBS:], 1)  # 51 -> 52 limbs
+    hi = jnp.concatenate(
+        [hi[:3] + lo52[NLIMBS : NLIMBS + 3], hi[3:]], axis=0
+    )
+    out_ref[:] = _norm(hi, 3)
+
+
+def _mul_flat(at, bt, nblocks):
+    """at, bt: f32 [52, nblocks*TN] transposed operands -> [52, n] product."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.pallas_call(
+        _mul_kernel,
+        out_shape=jax.ShapeDtypeStruct((NLIMBS, nblocks * TN), jnp.float32),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (NLIMBS, TN), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (NLIMBS, TN), lambda i: (0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (_OUT2, NLIMBS * NLIMBS),
+                lambda i: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (NLIMBS, 1), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (NLIMBS, 1), lambda i: (0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (NLIMBS, TN), lambda i: (0, i), memory_space=pltpu.VMEM
+        ),
+    )(at, bt, _BAND_T, _NPRIME_COL_J, _P_COL_J)
+
+
+_ENABLED = None
+
+
+def enabled():
+    """Pallas path active? auto: only on a real TPU backend."""
+    global _ENABLED
+    if _ENABLED is None:
+        flag = os.environ.get("COCONUT_FP_PALLAS", "auto")
+        if flag == "auto":
+            try:
+                _ENABLED = jax.default_backend() == "tpu"
+            except Exception:  # pragma: no cover
+                _ENABLED = False
+        else:
+            _ENABLED = flag == "1"
+    return _ENABLED
+
+
+def mul(a, b):
+    """Drop-in fused replacement for fp.mul on TPU: same element classes,
+    bit-identical results. Flattens leading dims, pads lanes to TN, runs
+    the transposed Pallas kernel, restores shape."""
+    shape = jnp.broadcast_shapes(a.shape, b.shape)
+    a = jnp.broadcast_to(a, shape).reshape(-1, NLIMBS)
+    b = jnp.broadcast_to(b, shape).reshape(-1, NLIMBS)
+    n = a.shape[0]
+    nblocks = -(-n // TN)
+    pad = nblocks * TN - n
+    if pad:
+        zpad = jnp.zeros((pad, NLIMBS), jnp.float32)
+        a = jnp.concatenate([a, zpad], axis=0)
+        b = jnp.concatenate([b, zpad], axis=0)
+    out = _mul_flat(a.T, b.T, nblocks).T
+    if pad:
+        out = out[:n]
+    return out.reshape(shape)
