@@ -1,17 +1,25 @@
 """Fused Pallas TPU kernel for the Montgomery multiply (fp.mul).
 
 Why: the XLA formulation of `fp.mul` materializes the schoolbook outer
-product (a 52x data expansion, [N, 2704] f32) plus its two byte planes in
-HBM for every multiply — measured to make every kernel HBM-bound. This
-kernel keeps the whole REDC pipeline (input carry passes, three band
-contractions, low-half carry extraction, output normalization) in VMEM:
-per lane only 104 input + 52 output limbs cross HBM, and the three
-byte-plane matmul pairs run back-to-back on the MXU.
+product (a 52x data expansion, [N, 2704] f32) plus byte planes in HBM for
+every multiply — measured to make every kernel HBM-bound. This kernel
+keeps the whole REDC pipeline (input carry passes, three limb-product
+reductions, low-half carry extraction, output normalization) in VMEM: per
+lane only 104 input + 52 output limbs cross HBM.
 
 Layout: everything TRANSPOSED to [limbs, lanes] — the lane (batch) axis
-sits in the 128-wide vector lanes, so the carry shift (`_shift_up`) is a
-static concatenate on the sublane axis, and the band contraction is
-[out_len, 2704] @ [2704, TN] with the batch in the minor dimension.
+sits in the 128-wide vector lanes, so every carry shift and coefficient
+shift is a static concatenate on the sublane axis.
+
+The limb product itself is a pure-VPU "comb": the [52, 52, TN] outer
+product's rows are shift-aligned and summed in a pairwise tree, split into
+low/high coefficient halves to avoid padding (every coefficient is a sum
+of <= 52 products <= 132^2 — exact f32, no byte planes, no matmul). This
+measured 52.5 ns/lane vs 92.2 for the int8-MXU band contraction and 351.5
+for the XLA path: the band matmul's 95x MAC redundancy makes even the MXU
+lose to straight VPU accumulation here. The MXU band path is kept behind
+COCONUT_PALLAS_VPU=0 (int8 planes by default there; COCONUT_FP_INT8=0 for
+bf16).
 
 The arithmetic is the same proof-carrying pipeline as fp.mul (see fp.py's
 import asserts): inputs LAZY (|limbs| <= 2^17, top two limbs vacant),
@@ -45,11 +53,13 @@ _OUT2 = 2 * NLIMBS - 1  # 103
 # All Montgomery constants and the band structure are shared with fp.py so
 # the two paths can never desynchronize (fp imports this module lazily
 # inside mul, so there is no import cycle).
-_BAND_T = jnp.asarray(_fp._BAND_NP.T.copy(), dtype=jnp.bfloat16)
+# numpy (host) constants only at module level — jnp.asarray here would
+# create traced constants when this module is first imported inside a jit
+# trace (fp.mul imports lazily), leaking tracers into the globals; the jnp
+# conversion happens per call site (deduped per jit trace).
+_BAND_T_NP = _fp._BAND_NP.T.copy()
 _NPRIME_COL = np.asarray(_fp._NPRIME_J).reshape(NLIMBS, 1)
 _P_COL = np.asarray(_fp._P_BAL_J).reshape(NLIMBS, 1)
-_NPRIME_COL_J = jnp.asarray(_NPRIME_COL)
-_P_COL_J = jnp.asarray(_P_COL)
 
 _BASE = 256.0
 _INV_BASE = 1.0 / 256.0
@@ -78,11 +88,63 @@ def _ext(t, extra):
     )
 
 
+_VPU = os.environ.get("COCONUT_PALLAS_VPU", "1") == "1"
+
+
 def _mul_kernel(a_ref, b_ref, band_ref, np_ref, p_ref, out_ref):
     a = _norm(a_ref[:], 2)  # [52, TN], |limbs| <= 132
     b = _norm(b_ref[:], 2)
 
+    def school_vpu(x, y, out_len):
+        """Comb schoolbook on the VPU: shift-align the outer product's
+        rows and tree-sum them. Every coefficient is a sum of <= 52
+        products <= 132^2 — exact f32, no byte planes, no matmul.
+        out_len < 103 truncates AFTER the sum (dropped terms belong to
+        limbs >= 52 and must not alias into the kept ones)."""
+        tn = x.shape[1]
+        outer = x[:, None, :] * y[None, :, :]  # [52, 52, TN]
+
+        def tree(terms):  # pairwise tree: log depth for VPU ILP
+            while len(terms) > 1:
+                nxt = [
+                    terms[k] + terms[k + 1]
+                    for k in range(0, len(terms) - 1, 2)
+                ]
+                if len(terms) % 2:
+                    nxt.append(terms[-1])
+                terms = nxt
+            return terms[0]
+
+        # Row i of the outer product contributes to coefficients
+        # [i, i+52): rows [0:52-i) of the low half t[0:52) and rows
+        # [0:i) of the high half t[52:103). Summing the two halves
+        # separately avoids padding every term to the full 103 rows
+        # (52x103 -> ~2x52x52 lane-adds).
+        lo_terms, hi_terms = [], []
+        for i in range(NLIMBS):
+            row = outer[i]
+            if i == 0:
+                lo_terms.append(row)
+                continue
+            lo_terms.append(
+                jnp.concatenate(
+                    [jnp.zeros((i, tn), x.dtype), row[: NLIMBS - i]], axis=0
+                )
+            )
+            hi_terms.append(
+                jnp.concatenate(
+                    [row[NLIMBS - i :], jnp.zeros((NLIMBS - 1 - i, tn), x.dtype)]
+                    if i < NLIMBS - 1
+                    else [row[NLIMBS - i :]],
+                    axis=0,
+                )
+            )
+        t = jnp.concatenate([tree(lo_terms), tree(hi_terms)], axis=0)
+        return t[:out_len]
+
     def school(x, y, out_len):
+        if _VPU:
+            return school_vpu(x, y, out_len)
         # outer[i, j, :] = x[i, :] * y[j, :] -> band-sum over i + j == k
         outer = x[:, None, :] * y[None, :, :]
         flat = outer.reshape(NLIMBS * NLIMBS, x.shape[1])
@@ -165,7 +227,13 @@ def _mul_flat(at, bt, nblocks):
         out_specs=pl.BlockSpec(
             (NLIMBS, TN), lambda i: (0, i), memory_space=pltpu.VMEM
         ),
-    )(at, bt, _BAND_T, _NPRIME_COL_J, _P_COL_J)
+    )(
+        at,
+        bt,
+        jnp.asarray(_BAND_T_NP, dtype=jnp.bfloat16),
+        jnp.asarray(_NPRIME_COL),
+        jnp.asarray(_P_COL),
+    )
 
 
 _ENABLED = None
