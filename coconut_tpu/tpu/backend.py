@@ -241,34 +241,38 @@ _fused_verify_combined_kernel = functools.partial(
 )(fused_verify_combined)
 
 
-def _grouped_msms(fl, x, y, inf, digits):
-    """M MSMs over the SAME [B] points: digits [M, B, nwin] (4-bit, msb
-    first) -> projective accumulators [M].
+def _grouped_msms(fl, x, y, inf, mag, sgn):
+    """M MSMs over the SAME [B] points: signed 5-bit window digits
+    mag/sgn [M, B, nwin] (msb first, digit = (-1)^sgn * mag, mag <= 16)
+    -> projective accumulators [M].
 
     Structure (this is the whole per-credential cost of the grouped verify
     — no OtherGroup arithmetic, no per-credential pairing):
-      1. one on-device table build (15 batched adds over [B]);
+      1. one on-device 17-entry table build (16 batched adds over [B]);
       2. ONE gather of all (msm, window, point) table entries [M, nwin, B]
          — the window axis rides in the lane dimension, so the fold runs
-         at full width instead of once per window;
+         at full width instead of once per window — with the sign applied
+         as a Y-flip (free elementwise negate + lane select);
       3. fold over the B axis: ~B-1 lane-adds per (m, w) via fold_points;
-      4. a Horner scan over the nwin window sums: 4 doublings + 1 add on
+      4. a Horner scan over the nwin window sums: 5 doublings + 1 add on
          [M] lanes per window."""
-    tables = cv.build_tables_device(fl, x, y, inf)  # leaves [B, 16, ...]
-    M, B, nwin = digits.shape
-    dw = jnp.moveaxis(digits, 1, 2)  # [M, nwin, B]
+    tables = cv.build_tables_device(fl, x, y, inf, entries=17)
+    M, B, nwin = mag.shape
+    dw = jnp.moveaxis(mag, 1, 2)  # [M, nwin, B]
+    sw = jnp.moveaxis(sgn, 1, 2)
 
-    def leaf(t):  # t: [B, 16, L...] -> [M, nwin, B, L...]
+    def leaf(t):  # t: [B, 17, L...] -> [M, nwin, B, L...]
         tb = jnp.broadcast_to(t[None, None], (M, nwin) + t.shape)
         ix = dw[..., None].reshape(dw.shape + (1,) * (t.ndim - 1))
         return jnp.take_along_axis(tb, ix, axis=3)[:, :, :, 0]
 
-    pts = jax.tree_util.tree_map(leaf, tables)  # [M, nwin, B]
-    S = cv.fold_points(fl, pts, B, axis_offset=2)  # [M, nwin] window sums
+    X, Y, Z = jax.tree_util.tree_map(leaf, tables)  # [M, nwin, B]
+    Y = fl.select(sw, fl.neg(Y), Y)  # signed digit -> negated point
+    S = cv.fold_points(fl, (X, Y, Z), B, axis_offset=2)  # [M, nwin] sums
     Sw = jax.tree_util.tree_map(lambda t: jnp.moveaxis(t, 1, 0), S)
 
     def body(acc, s):
-        acc = jax.lax.fori_loop(0, 4, lambda _, a: cv.jdouble(fl, a), acc)
+        acc = jax.lax.fori_loop(0, 5, lambda _, a: cv.jdouble(fl, a), acc)
         return cv.jadd(fl, acc, s), None
 
     acc, _ = jax.lax.scan(body, cv.jinfinity(fl, (M,)), Sw)
@@ -276,7 +280,7 @@ def _grouped_msms(fl, x, y, inf, digits):
 
 
 def fused_verify_grouped(
-    sig_is_g1, s1, s2n, inf1, inf2, cdigits, rdigits, ox, oy, gtx, gty
+    sig_is_g1, s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn, ox, oy, gtx, gty
 ):
     """Attribute-grouped combined batch verify — ONE boolean, q+2 pairs
     TOTAL regardless of batch size.
@@ -294,19 +298,19 @@ def fused_verify_grouped(
     batch (_grouped_msms). Soundness 2^-128 per forged credential, as in
     fused_verify_combined.
 
-    Shapes: s1/s2n coordinate pytrees [B]; cdigits [q+1, B, 64] (scalars
-    r_i then r_i*m_ij mod r); rdigits [1, B, 32] (r_i for the -s2 sum —
-    r_i are 128-bit so only the low 32 msb-first windows are passed);
-    ox/oy [q+1] other-group affine (X then Y_j); gtx/gty other-group affine
-    g. B power of two."""
+    Shapes: s1/s2n coordinate pytrees [B]; cmag/csgn [q+1, B, 52] signed
+    5-bit window digits (scalars r_i then r_i*m_ij mod r); rmag/rsgn
+    [1, B, 27] (r_i for the -s2 sum — r_i are 128-bit so only the low 27
+    msb-first windows can be nonzero); ox/oy [q+1] other-group affine (X
+    then Y_j); gtx/gty other-group affine g. B power of two."""
     sig_fl = cv.FP if sig_is_g1 else cv.FP2
     oth_fl = cv.FP2 if sig_is_g1 else cv.FP
     B = inf1.shape[0]
     dead = inf1 | inf2
 
     # dead lanes: zero digits (host guarantees) -> identity contributions
-    acc1 = _grouped_msms(sig_fl, s1[0], s1[1], inf1, cdigits)  # [q+1]
-    acc2 = _grouped_msms(sig_fl, s2n[0], s2n[1], inf2, rdigits)  # [1]
+    acc1 = _grouped_msms(sig_fl, s1[0], s1[1], inf1, cmag, csgn)  # [q+1]
+    acc2 = _grouped_msms(sig_fl, s2n[0], s2n[1], inf2, rmag, rsgn)  # [1]
     allacc = jax.tree_util.tree_map(
         lambda a, b: jnp.concatenate([a, b], axis=0), acc1, acc2
     )
@@ -717,12 +721,16 @@ class JaxBackend(CurveBackend):
             [r * (msgs[j] % R) % R for r, msgs in zip(rs, messages_list)]
             for j in range(q)
         ]
-        cdigits = jnp.asarray(
-            np.stack([fr_digits_np(row) for row in rows])
-        )  # [q+1, Bp, 64]
-        # r_i are 128-bit: the top 32 windows of the r-row are zero — slice
-        # them off so the -sigma_2 MSM runs half the window schedule
-        rdigits = cdigits[:1, :, 32:]
+        from .limbs import fr_digits_signed_np
+
+        recoded = [fr_digits_signed_np(row) for row in rows]
+        cmag = jnp.asarray(np.stack([m for m, _ in recoded]))
+        csgn = jnp.asarray(np.stack([s for _, s in recoded]))  # [q+1, Bp, 52]
+        # r_i are 128-bit: only the last 27 msb-first windows of the r-row
+        # can be nonzero — slice so the -sigma_2 MSM runs a short schedule
+        assert not recoded[0][0][:, : 52 - 27].any()
+        rmag = cmag[:1, :, 52 - 27 :]
+        rsgn = csgn[:1, :, 52 - 27 :]
 
         s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
             ctx,
@@ -745,8 +753,10 @@ class JaxBackend(CurveBackend):
             s2n,
             inf1,
             inf2,
-            cdigits,
-            rdigits,
+            cmag,
+            csgn,
+            rmag,
+            rsgn,
             ox,
             oy,
             gtx,

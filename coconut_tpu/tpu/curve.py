@@ -165,19 +165,23 @@ def affine_to_jacobian(fl, x, y, inf):
     )
 
 
-def build_tables_device(fl, x, y, inf):
-    """On-device per-point projective multiples 0..15 for the windowed
-    MSMs. x, y: affine coordinate pytrees [..., k]; inf: bool [..., k].
-    Returns a pytree with leaves [..., k, 16, limbs...]. The 15 chained
-    complete adds run as a `lax.scan` so jadd is compiled ONCE; amortized
-    over the whole [..., k] batch."""
+def build_tables_device(fl, x, y, inf, entries=16):
+    """On-device per-point projective multiples 0..entries-1 for the
+    windowed MSMs. x, y: affine coordinate pytrees [..., k]; inf: bool
+    [..., k]. Returns a pytree with leaves [..., k, entries, limbs...].
+    The chained complete adds run as a `lax.scan` so jadd is compiled
+    ONCE; amortized over the whole [..., k] batch. entries=17 serves the
+    signed 5-bit window schedule (digits in [-16, 16], negation is a
+    Y-flip on the gathered entry)."""
     jac = affine_to_jacobian(fl, x, y, inf)
 
     def body(prev, _):
-        return jadd(fl, prev, jac), prev  # emits entries 0..15
+        return jadd(fl, prev, jac), prev  # emits entries 0..entries-1
 
-    _, rows = jax.lax.scan(body, jinfinity(fl, inf.shape), None, length=16)
-    # rows leaves: [16, ..., k, L] -> [..., k, 16, L]
+    _, rows = jax.lax.scan(
+        body, jinfinity(fl, inf.shape), None, length=entries
+    )
+    # rows leaves: [entries, ..., k, L] -> [..., k, entries, L]
     return jax.tree_util.tree_map(
         lambda t: jnp.moveaxis(t, 0, inf.ndim), rows
     )

@@ -139,6 +139,8 @@ def _mul_kernel(a_ref, b_ref, band_ref, np_ref, p_ref, out_ref):
                     axis=0,
                 )
             )
+        if out_len <= NLIMBS:  # REDC's m-step: the high half is discarded
+            return tree(lo_terms)[:out_len]
         t = jnp.concatenate([tree(lo_terms), tree(hi_terms)], axis=0)
         return t[:out_len]
 
@@ -201,6 +203,14 @@ def _mul_flat(at, bt, nblocks):
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if _VPU:
+        # band matrix unused by the VPU comb: ship a 1x1 dummy instead of
+        # copying ~557 KB HBM->VMEM per launch
+        band = jnp.zeros((1, 128), jnp.bfloat16)
+        band_shape = (1, 128)
+    else:
+        band = jnp.asarray(_BAND_T_NP, dtype=jnp.bfloat16)
+        band_shape = (_OUT2, NLIMBS * NLIMBS)
     return pl.pallas_call(
         _mul_kernel,
         out_shape=jax.ShapeDtypeStruct((NLIMBS, nblocks * TN), jnp.float32),
@@ -213,7 +223,7 @@ def _mul_flat(at, bt, nblocks):
                 (NLIMBS, TN), lambda i: (0, i), memory_space=pltpu.VMEM
             ),
             pl.BlockSpec(
-                (_OUT2, NLIMBS * NLIMBS),
+                band_shape,
                 lambda i: (0, 0),
                 memory_space=pltpu.VMEM,
             ),
@@ -230,7 +240,7 @@ def _mul_flat(at, bt, nblocks):
     )(
         at,
         bt,
-        jnp.asarray(_BAND_T_NP, dtype=jnp.bfloat16),
+        band,
         jnp.asarray(_NPRIME_COL),
         jnp.asarray(_P_COL),
     )
