@@ -150,20 +150,18 @@ def bench_jax(batch, reps, ge, params, sk, vk, sigs, msgs_list, extras):
         )
 
         def run():
+            # time through the host transfer: block_until_ready has been
+            # observed returning early over the axon tunnel, which would
+            # credit the kernel time to "readback" instead. The [B] bool
+            # transfer itself is sub-millisecond.
             with metrics.timer("kernel"):
                 out = _fused_verify_kernel(sig_is_g1, *operands)
-                out.block_until_ready()
-            return out
+                return np.asarray(out)
 
-        t_kernel, bits = _timeit(run, reps)
-        with metrics.timer("readback"):
-            host_bits = np.asarray(bits)
+        t_kernel, host_bits = _timeit(run, reps)
         assert bool(host_bits.all()), "verification bits wrong"
         extras["percred_kernel_s"] = round(t_kernel, 4)
         extras["percred_verifies_per_sec"] = round(batch / t_kernel, 2)
-        extras["readback_s"] = round(
-            metrics.snapshot()["timers_s"]["readback"], 5
-        )
 
     if os.environ.get("BENCH_COMBINED", "0") == "1":
         # combined (small-exponents) batch verify: one bool per batch,
