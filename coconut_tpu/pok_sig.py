@@ -4,10 +4,14 @@
 The reference's pok_sig.rs is a 6-line delegation to ps_sig plus a test
 (pok_sig.rs:1-6); here the protocol lives in `coconut_tpu.ps` and this module
 provides the convenience pair the README's 8-step flow ends with
-(README.md:141-172)."""
+(README.md:141-172) — plus `batch_show`, the batched prover (VERDICT r2
+item 4: the sequential prover dwarfed the batched verifier)."""
 
+from .ops.fields import R
+from .pok_vc import Proof
 from .ps import PoKOfSignature, PoKOfSignatureProof  # noqa: F401 (re-export)
 from .signature import fiat_shamir_challenge
+from .sss import rand_fr
 
 
 def show(sig, vk, params, messages, revealed_msg_indices, blindings=None):
@@ -22,6 +26,110 @@ def show(sig, vk, params, messages, revealed_msg_indices, blindings=None):
     proof = pok.gen_proof(challenge)
     revealed_msgs = {i: messages[i] for i in proof.revealed_msg_indices}
     return proof, challenge, revealed_msgs
+
+
+def batch_show(sigs, vk, params, messages_list, revealed_msg_indices,
+               backend=None):
+    """Batched prover side of Show: the same per-credential proofs `show`
+    produces (identical math; fresh per-credential randomness), with every
+    group operation routed through a `CurveBackend` so the whole batch runs
+    as a handful of fused MSM kernels instead of 4B host scalar-muls
+    (reference surface pok_sig.rs:85-95).
+
+    All credentials share one revealed-index set (the batchable shape; mixed
+    sets should call `show` per credential). Returns (proofs, challenges,
+    revealed_msgs_list)."""
+    B = len(sigs)
+    if len(messages_list) != B:
+        raise ValueError(
+            "batch size mismatch: %d sigs, %d message vectors"
+            % (B, len(messages_list))
+        )
+    if backend is None or B == 0:
+        out = [
+            show(s, vk, params, m, revealed_msg_indices)
+            for s, m in zip(sigs, messages_list)
+        ]
+        return (
+            [o[0] for o in out],
+            [o[1] for o in out],
+            [o[2] for o in out],
+        )
+    if isinstance(backend, str):
+        from .backend import get_backend
+
+        backend = get_backend(backend)
+    ctx = params.ctx
+    revealed = set(revealed_msg_indices)
+    q = len(vk.Y_tilde)
+    for msgs in messages_list:
+        if len(msgs) != q:
+            from .errors import UnsupportedNoOfMessages
+
+            raise UnsupportedNoOfMessages(q, len(msgs))
+    for i in revealed:
+        if not 0 <= i < q:
+            raise ValueError("revealed index %d out of range" % i)
+    hidden = [i for i in range(q) if i not in revealed]
+    if ctx.name == "G1":
+        msm_sig_distinct = backend.msm_g1_distinct
+        msm_other_shared = backend.msm_g2_shared
+    else:
+        msm_sig_distinct = backend.msm_g2_distinct
+        msm_other_shared = backend.msm_g1_shared
+
+    # per-credential randomness (same sampling as PoKOfSignature.__init__)
+    rs = [rand_fr() for _ in range(B)]
+    ts = [rand_fr() for _ in range(B)]
+    blindings = [[rand_fr() for _ in range(1 + len(hidden))] for _ in range(B)]
+
+    # sigma'_1 = sigma_1^r ; sigma'_2 = (sigma_2 + t sigma_1)^r
+    #          = sigma_2^r + sigma_1^{t r}  — 1- and 2-term distinct MSMs
+    sigma1p = msm_sig_distinct(
+        [[s.sigma_1] for s in sigs], [[r] for r in rs]
+    )
+    sigma2p = msm_sig_distinct(
+        [[s.sigma_2, s.sigma_1] for s in sigs],
+        [[r, t * r % R] for r, t in zip(rs, ts)],
+    )
+    # J = g_tilde^t * prod_hidden Y_j^{m_j} and the Schnorr commitment
+    # t-point over the SAME shared bases — two comb MSMs
+    bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
+    secrets_rows = [
+        [t] + [msgs[i] for i in hidden]
+        for t, msgs in zip(ts, messages_list)
+    ]
+    Js = msm_other_shared(bases, [[s % R for s in row] for row in secrets_rows])
+    comms = msm_other_shared(bases, blindings)
+
+    # Fiat-Shamir + responses, host-side (cheap field/hash work)
+    bases_bytes = b"".join(ctx.other_to_bytes(b) for b in bases)
+    proofs, challenges, revealed_list = [], [], []
+    for i in range(B):
+        transcript = (
+            ctx.sig_to_bytes(sigma1p[i])
+            + ctx.sig_to_bytes(sigma2p[i])
+            + ctx.other_to_bytes(Js[i])
+            + bases_bytes
+            + ctx.other_to_bytes(comms[i])
+        )
+        c = fiat_shamir_challenge(transcript)
+        responses = [
+            (b - c * s) % R
+            for b, s in zip(blindings[i], secrets_rows[i])
+        ]
+        proofs.append(
+            PoKOfSignatureProof(
+                sigma1p[i],
+                sigma2p[i],
+                Js[i],
+                Proof(comms[i], responses),
+                revealed,
+            )
+        )
+        challenges.append(c)
+        revealed_list.append({j: messages_list[i][j] for j in revealed})
+    return proofs, challenges, revealed_list
 
 
 def show_verify(proof, vk, params, revealed_msgs, challenge=None):

@@ -520,6 +520,98 @@ class BlindSignature:
         )
 
 
+def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
+                             backend=None):
+    """User-side PrepareBlindSign over a batch (VERDICT r2 item 4): the same
+    per-request output as `SignatureRequest.new` (signature.rs:124-207) with
+    the commitment MSMs, ElGamal scalar mults, and h^{m} terms each batched
+    through one backend MSM call. The per-request generator h is derived
+    through the native C++ hash-to-group when available (bit-identical to
+    the spec; tests/vectors/hashing.json).
+
+    Returns [(request, randomness)] — randomness = [r, k_1..k_hidden] per
+    request, exactly as the sequential path."""
+    from .backend import get_backend
+
+    B = len(messages_list)
+    if B == 0:
+        return []
+    if backend is None:
+        backend = get_backend("python")
+    elif isinstance(backend, str):
+        backend = get_backend(backend)
+    ctx = params.ctx
+    ops = ctx.sig
+    q = params.msg_count()
+    for msgs in messages_list:
+        if len(msgs) != q:
+            raise UnsupportedNoOfMessages(q, len(msgs))
+        if len(msgs) < count_hidden:
+            raise GeneralError(
+                "count_hidden %d exceeds message count %d"
+                % (count_hidden, len(msgs))
+            )
+    msm_shared = (
+        backend.msm_g1_shared if ctx.name == "G1" else backend.msm_g2_shared
+    )
+    msm_distinct = (
+        backend.msm_g1_distinct
+        if ctx.name == "G1"
+        else backend.msm_g2_distinct
+    )
+
+    # commitments: shared bases [h_0..h_hidden-1, g], per-request scalars
+    rs = [rand_fr() for _ in range(B)]
+    commit_bases = list(params.h[:count_hidden]) + [params.g]
+    commitments = msm_shared(
+        commit_bases,
+        [list(m[:count_hidden]) + [r] for m, r in zip(messages_list, rs)],
+    )
+    known_lists = [list(m[count_hidden:]) for m in messages_list]
+    if count_hidden == 0:
+        return [
+            (SignatureRequest(k, c, []), [r])
+            for k, c, r in zip(known_lists, commitments, rs)
+        ]
+
+    # per-request anti-malleability generator h (hash of public data);
+    # the native core is ~2 orders faster than the Python spec here
+    from . import native as _native
+
+    hash_native = ctx.name == "G1" and _native.available()
+    hs = []
+    for c, known in zip(commitments, known_lists):
+        data = ctx.sig_to_bytes(c) + b"".join(
+            ser.fr_to_bytes(m) for m in known
+        )
+        hs.append(
+            _native.hash_to_g1(data) if hash_native else ctx.hash_to_sig(data)
+        )
+
+    # ElGamal over all B*hidden slots in three batched MSMs:
+    #   c1 = g^k (shared), pk^k (shared), h_i^{m_ij} (distinct — h varies)
+    ks = [[rand_fr() for _ in range(count_hidden)] for _ in range(B)]
+    flat_k = [[k] for row in ks for k in row]
+    gk = msm_shared([params.g], flat_k)
+    pkk = msm_shared([elgamal_pk], flat_k)
+    hm = msm_distinct(
+        [[h] for h in hs for _ in range(count_hidden)],
+        [[m % R] for msgs in messages_list for m in msgs[:count_hidden]],
+    )
+    out = []
+    for i, (msgs, known, c, h, r) in enumerate(
+        zip(messages_list, known_lists, commitments, hs, rs)
+    ):
+        cts = []
+        for j in range(count_hidden):
+            f = i * count_hidden + j
+            cts.append((gk[f], ops.add(pkk[f], hm[f])))
+        req = SignatureRequest(known, c, cts)
+        req._h_cache = h
+        out.append((req, [r] + ks[i]))
+    return out
+
+
 def batch_blind_sign(sig_requests, sigkey, params, backend=None):
     """Signer-side BlindSign over a batch of requests (BASELINE config 4).
 

@@ -34,18 +34,25 @@ from ..ops.fields import R
 from . import curve as cv
 from . import pairing as pr
 from . import tower as tw
-from .limbs import fr_digits_np
+# Bit length of the small-exponents combiner scalars r_i (batch_verify_
+# combined / _grouped sample secrets.randbits(_R_RAND_BITS)). The signed
+# 5-bit recode of a (<2^128)-value occupies ceil(128/5) = 26 windows plus
+# one carry window — everything above _R_NWIN is structurally zero, so the
+# -sigma_2 MSM can run the short schedule.
+_R_RAND_BITS = 128
+_R_NWIN = -(-_R_RAND_BITS // 5) + 1  # 27
 
-_WINDOW = 4
-_NDIG = 64
+
+_SIGNED_NWIN = 52  # signed 5-bit windows covering the 255-bit Fr
 
 
-def _build_tables(spec_ops, bases):
-    """Host-side: per-base projective multiples 0..15 as spec coordinate
-    tuples (identity = (0, 1, 0), the complete-formula encoding)."""
+def _build_tables(spec_ops, bases, entries=16):
+    """Host-side: per-base projective multiples 0..entries-1 as spec
+    coordinate tuples (identity = (0, 1, 0), the complete-formula encoding).
+    entries=17 serves the signed 5-bit schedule (digits in [-16, 16])."""
     tables = []
     for b in bases:
-        row = [None] + [spec_ops.mul(b, d) for d in range(1, 16)]
+        row = [None] + [spec_ops.mul(b, d) for d in range(1, entries)]
         enc = []
         for p in row:
             if p is None:
@@ -53,34 +60,61 @@ def _build_tables(spec_ops, bases):
             else:
                 enc.append((p[0], p[1], spec_ops.one))
         tables.append(enc)
-    # encode: [k][16] of (X, Y, Z) -> pytree with leading [k, 16]
+    # encode: [k][entries] of (X, Y, Z) -> pytree with leading [k, entries]
     flat = [e for row in tables for e in row]
     tree = tw.encode_batch(flat)
     k = len(bases)
     return jax.tree_util.tree_map(
-        lambda t: t.reshape((k, 16) + t.shape[1:]), tree
+        lambda t: t.reshape((k, entries) + t.shape[1:]), tree
     )
-
-
-def _r128_digits(r):
-    """128-bit combiner scalar -> 32 4-bit window digits, msb first."""
-    return np.array(
-        [(r >> (4 * i)) & 0xF for i in range(31, -1, -1)], dtype=np.uint32
-    )
-
-
-def _digits(scalars_batch):
-    """[B][k] ints -> uint32 [B, k, 64] window digits (vectorized)."""
-    B = len(scalars_batch)
-    k = len(scalars_batch[0]) if B else 0
-    flat = [s for row in scalars_batch for s in row]
-    return jnp.asarray(fr_digits_np(flat).reshape(B, k, _NDIG))
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
-def _msm_affine_kernel(field_is_fp2, tables, digits):
+def _comb_build_kernel(field_is_fp2, tables17):
     fl = cv.FP2 if field_is_fp2 else cv.FP
-    acc = cv.msm_shared(fl, tables, digits)
+    return cv.build_comb_tables(fl, tables17, _SIGNED_NWIN)
+
+
+# (is_fp2, base points) -> device comb tables. Bases are spec tuples of
+# ints (hashable); the dominant user is the per-verkey fused verify, so a
+# handful of entries live here per process — worth it: table build (host
+# multiples + 52x5 device doublings) amortizes across every batch that
+# reuses the verkey.
+_COMB_CACHE = {}
+
+
+def _comb_tables(spec_ops, is_fp2, bases, cache=True):
+    key = (is_fp2, tuple(bases))
+    wt = _COMB_CACHE.get(key)
+    if wt is None:
+        t17 = _build_tables(spec_ops, bases, entries=17)
+        wt = _comb_build_kernel(is_fp2, t17)
+        if cache:
+            if len(_COMB_CACHE) > 64:  # ad-hoc base sets must not pile up
+                _COMB_CACHE.clear()
+            _COMB_CACHE[key] = wt
+    return wt
+
+
+def _signed_digits(scalars_batch):
+    """[B][k] ints -> (mag uint8, sgn bool) [B, k, 52] signed 5-bit window
+    digits (msb first), the comb/signed-Horner MSM schedule."""
+    from .limbs import fr_digits_signed_np
+
+    B = len(scalars_batch)
+    k = len(scalars_batch[0]) if B else 0
+    flat = [s for row in scalars_batch for s in row]
+    mag, sgn = fr_digits_signed_np(flat)
+    return (
+        jnp.asarray(mag.reshape(B, k, _SIGNED_NWIN)),
+        jnp.asarray(sgn.reshape(B, k, _SIGNED_NWIN)),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _msm_affine_kernel(field_is_fp2, wtables, mag, sgn):
+    fl = cv.FP2 if field_is_fp2 else cv.FP
+    acc = cv.msm_shared_comb(fl, wtables, mag, sgn)
     return cv.to_affine(fl, acc)
 
 
@@ -90,18 +124,39 @@ def _pairing_kernel(px, py, qx, qy, valid):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
-def _msm_distinct_affine_kernel(field_is_fp2, x, y, inf, digits):
+def _msm_distinct_affine_kernel(field_is_fp2, x, y, inf, mag, sgn):
     fl = cv.FP2 if field_is_fp2 else cv.FP
-    acc = cv.msm_distinct(fl, x, y, inf, digits)
+    acc = cv.msm_distinct_signed(fl, x, y, inf, mag, sgn)
     return cv.to_affine(fl, acc)
 
 
 def verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2):
     """Post-MSM half of the fused verify: normalize the accumulator and run
     the 2-pair pairing product. Split out so the sharded path (shard.py) can
-    combine cross-device MSM partials before entering it."""
+    combine cross-device MSM partials before entering it.
+
+    G1 assignment uses the specialized two-pair loop with pair 2's shared
+    g_tilde ladder and a merged [B] accumulator (pr.miller_two_pairs_
+    shared_q2); the G2 assignment keeps the generic pair-set loop (there
+    the shared element g_tilde sits on the evaluation side already)."""
     acc_fl = cv.FP2 if sig_is_g1 else cv.FP
     ax, ay, ainf = cv.to_affine(acc_fl, acc)
+
+    if sig_is_g1:
+        f = pr.miller_two_pairs_shared_q2(
+            s1[0],
+            s1[1],
+            ax,
+            ay,
+            ~inf1 & ~ainf,
+            s2n[0],
+            s2n[1],
+            gtx,
+            gty,
+            ~inf2,
+        )
+        one = tw.fp12_is_one(pr.final_exp(f))
+        return one & ~inf1
 
     def stack2(a, b):
         return jax.tree_util.tree_map(
@@ -112,34 +167,28 @@ def verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2):
             b,
         )
 
-    if sig_is_g1:
-        px = stack2(s1[0], s2n[0])
-        py = stack2(s1[1], s2n[1])
-        qx = stack2(ax, gtx)
-        qy = stack2(ay, gty)
-        pinf = jnp.stack([inf1, inf2], axis=-1)
-        qinf = jnp.stack([ainf, jnp.zeros_like(ainf)], axis=-1)
-    else:
-        px = stack2(ax, gtx)
-        py = stack2(ay, gty)
-        qx = stack2(s1[0], s2n[0])
-        qy = stack2(s1[1], s2n[1])
-        qinf = jnp.stack([inf1, inf2], axis=-1)
-        pinf = jnp.stack([ainf, jnp.zeros_like(ainf)], axis=-1)
+    px = stack2(ax, gtx)
+    py = stack2(ay, gty)
+    qx = stack2(s1[0], s2n[0])
+    qy = stack2(s1[1], s2n[1])
+    qinf = jnp.stack([inf1, inf2], axis=-1)
+    pinf = jnp.stack([ainf, jnp.zeros_like(ainf)], axis=-1)
     valid = ~(pinf | qinf)
     one = pr.pairing_product_is_one(px, py, qx, qy, valid)
     return one & ~inf1
 
 
-def fused_verify(sig_is_g1, tables, digits, s1, s2n, gtx, gty, inf1, inf2):
-    """Fused batch verify: MSM accumulator + 2-pair pairing product.
+def fused_verify(sig_is_g1, wtables, mag, sgn, s1, s2n, gtx, gty, inf1, inf2):
+    """Fused batch verify: comb MSM accumulator + 2-pair pairing product.
 
     sig_is_g1: signatures live in G1 (ctx "G1") — accumulator is in G2;
-    otherwise roles flip. s1/s2n: sigma_1 and -sigma_2 coordinate pytrees
-    [B]; gtx/gty: g_tilde affine coordinates pre-encoded as limb pytrees;
-    inf1/inf2: identity masks for sigma_1 / sigma_2."""
+    otherwise roles flip. wtables: per-verkey comb window tables
+    (cv.build_comb_tables); mag/sgn: signed 5-bit digits [B, k, 52];
+    s1/s2n: sigma_1 and -sigma_2 coordinate pytrees [B]; gtx/gty: g_tilde
+    affine coordinates pre-encoded as limb pytrees; inf1/inf2: identity
+    masks for sigma_1 / sigma_2."""
     acc_fl = cv.FP2 if sig_is_g1 else cv.FP
-    acc = cv.msm_shared(acc_fl, tables, digits)
+    acc = cv.msm_shared_comb(acc_fl, wtables, mag, sgn)
     return verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2)
 
 
@@ -163,7 +212,7 @@ def _tree_fold_fp12(f, n):
 
 
 def fused_verify_combined(
-    sig_is_g1, tables, digits, s1, s2n, rdigits, gtx, gty, inf1, inf2
+    sig_is_g1, wtables, mag, sgn, s1, s2n, rmag, rsgn, gtx, gty, inf1, inf2
 ):
     """Probabilistic combined batch verify — ONE boolean for the whole batch.
 
@@ -183,18 +232,19 @@ def fused_verify_combined(
     sig_fl = cv.FP if sig_is_g1 else cv.FP2
     B = inf1.shape[0]
 
-    acc = cv.msm_shared(acc_fl, tables, digits)
+    acc = cv.msm_shared_comb(acc_fl, wtables, mag, sgn)
     ax, ay, ainf = cv.to_affine(acc_fl, acc)
 
     def add_k1(pt):
         return jax.tree_util.tree_map(lambda t: t[:, None], pt)
 
-    # r_i * sigma_1_i and r_i * (-sigma_2_i): k=1 distinct MSMs, 32 windows
-    s1r = cv.msm_distinct(
-        sig_fl, add_k1(s1[0]), add_k1(s1[1]), inf1[:, None], rdigits
+    # r_i * sigma_1_i and r_i * (-sigma_2_i): k=1 signed distinct MSMs over
+    # the short 27-window (128-bit r_i) schedule
+    s1r = cv.msm_distinct_signed(
+        sig_fl, add_k1(s1[0]), add_k1(s1[1]), inf1[:, None], rmag, rsgn
     )
-    s2rn = cv.msm_distinct(
-        sig_fl, add_k1(s2n[0]), add_k1(s2n[1]), inf2[:, None], rdigits
+    s2rn = cv.msm_distinct_signed(
+        sig_fl, add_k1(s2n[0]), add_k1(s2n[1]), inf2[:, None], rmag, rsgn
     )
     # mask invalid lanes to the identity so they drop out of the sum
     dead = inf1 | inf2 | ainf
@@ -279,41 +329,22 @@ def _grouped_msms(fl, x, y, inf, mag, sgn):
     return acc
 
 
-def fused_verify_grouped(
-    sig_is_g1, s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn, ox, oy, gtx, gty
-):
-    """Attribute-grouped combined batch verify — ONE boolean, q+2 pairs
-    TOTAL regardless of batch size.
-
-    The small-exponents combination regrouped by verkey component: with
-    random 128-bit r_i and messages m_ij,
-
-      prod_i [e(s1_i, X * prod_j Y_j^{m_ij}) * e(-s2_i, g)]^{r_i}
-      = e(sum_i r_i s1_i, X)
-        * prod_j e(sum_i (r_i m_ij) s1_i, Y_j)
-        * e(sum_i r_i (-s2_i), g)
-
-    so ALL G2/OtherGroup arithmetic disappears (X, Y_j, g are fixed affine
-    inputs) and the per-credential work is q+2 shared-point G1 MSMs over the
-    batch (_grouped_msms). Soundness 2^-128 per forged credential, as in
-    fused_verify_combined.
-
-    Shapes: s1/s2n coordinate pytrees [B]; cmag/csgn [q+1, B, 52] signed
-    5-bit window digits (scalars r_i then r_i*m_ij mod r); rmag/rsgn
-    [1, B, 27] (r_i for the -s2 sum — r_i are 128-bit so only the low 27
-    msb-first windows can be nonzero); ox/oy [q+1] other-group affine (X
-    then Y_j); gtx/gty other-group affine g. B power of two."""
-    sig_fl = cv.FP if sig_is_g1 else cv.FP2
-    oth_fl = cv.FP2 if sig_is_g1 else cv.FP
-    B = inf1.shape[0]
-    dead = inf1 | inf2
-
-    # dead lanes: zero digits (host guarantees) -> identity contributions
+def grouped_accumulators(sig_fl, s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn):
+    """The per-credential half of the grouped verify: q+2 shared-point MSMs
+    over the (local) credential batch -> projective accumulators [q+2].
+    Split out so the dp-sharded path (shard.py) can combine cross-device
+    partials (point sums commute) before the pairing tail."""
     acc1 = _grouped_msms(sig_fl, s1[0], s1[1], inf1, cmag, csgn)  # [q+1]
     acc2 = _grouped_msms(sig_fl, s2n[0], s2n[1], inf2, rmag, rsgn)  # [1]
-    allacc = jax.tree_util.tree_map(
+    return jax.tree_util.tree_map(
         lambda a, b: jnp.concatenate([a, b], axis=0), acc1, acc2
     )
+
+
+def grouped_tail(sig_is_g1, allacc, ox, oy, gtx, gty, any_dead):
+    """Post-MSM half of the grouped verify: q+2 Miller pairs against the
+    fixed other-group points, one shared final exponentiation, one bool."""
+    sig_fl = cv.FP if sig_is_g1 else cv.FP2
     px, py, pinf = cv.to_affine(sig_fl, allacc)  # [q+2] sig-group points
 
     qx = jax.tree_util.tree_map(
@@ -349,7 +380,41 @@ def fused_verify_grouped(
         )
     prod = _tree_fold_fp12(f, pow2)
     ok = tw.fp12_is_one(pr.final_exp(prod))[0]
-    return ok & ~jnp.any(dead)
+    return ok & ~any_dead
+
+
+def fused_verify_grouped(
+    sig_is_g1, s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn, ox, oy, gtx, gty
+):
+    """Attribute-grouped combined batch verify — ONE boolean, q+2 pairs
+    TOTAL regardless of batch size.
+
+    The small-exponents combination regrouped by verkey component: with
+    random 128-bit r_i and messages m_ij,
+
+      prod_i [e(s1_i, X * prod_j Y_j^{m_ij}) * e(-s2_i, g)]^{r_i}
+      = e(sum_i r_i s1_i, X)
+        * prod_j e(sum_i (r_i m_ij) s1_i, Y_j)
+        * e(sum_i r_i (-s2_i), g)
+
+    so ALL G2/OtherGroup arithmetic disappears (X, Y_j, g are fixed affine
+    inputs) and the per-credential work is q+2 shared-point G1 MSMs over the
+    batch (_grouped_msms). Soundness 2^-128 per forged credential, as in
+    fused_verify_combined.
+
+    Shapes: s1/s2n coordinate pytrees [B]; cmag/csgn [q+1, B, 52] signed
+    5-bit window digits (scalars r_i then r_i*m_ij mod r); rmag/rsgn
+    [1, B, 27] (r_i for the -s2 sum — r_i are 128-bit so only the low 27
+    msb-first windows can be nonzero); ox/oy [q+1] other-group affine (X
+    then Y_j); gtx/gty other-group affine g. B power of two."""
+    sig_fl = cv.FP if sig_is_g1 else cv.FP2
+    # dead lanes: zero digits (host guarantees) -> identity contributions
+    allacc = grouped_accumulators(
+        sig_fl, s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn
+    )
+    return grouped_tail(
+        sig_is_g1, allacc, ox, oy, gtx, gty, jnp.any(inf1 | inf2)
+    )
 
 
 _fused_verify_grouped_kernel = functools.partial(
@@ -359,16 +424,19 @@ _fused_verify_grouped_kernel = functools.partial(
 
 def fused_show_verify(
     sig_is_g1,
-    vc_tables,
-    resp_digits,
+    vc_wtables,
+    resp_mag,
+    resp_sgn,
     jpt,
     jinf,
-    cdigits_j,
+    cmag_j,
+    csgn_j,
     commx,
     commy,
     comminf,
-    acc_tables,
-    acc_digits,
+    acc_wtables,
+    acc_mag,
+    acc_sgn,
     s1,
     s2n,
     gtx,
@@ -398,13 +466,14 @@ def fused_show_verify(
     oth_fl = cv.FP2 if sig_is_g1 else cv.FP
 
     # -- Schnorr check ------------------------------------------------------
-    vc = cv.msm_shared(oth_fl, vc_tables, resp_digits)
-    jterm = cv.msm_distinct(
+    vc = cv.msm_shared_comb(oth_fl, vc_wtables, resp_mag, resp_sgn)
+    jterm = cv.msm_distinct_signed(
         oth_fl,
         jax.tree_util.tree_map(lambda t: t[:, None], jpt[0]),
         jax.tree_util.tree_map(lambda t: t[:, None], jpt[1]),
         jinf[:, None],
-        cdigits_j,
+        cmag_j,
+        csgn_j,
     )
     lhs = cv.jadd(oth_fl, vc, jterm)
     lx, ly, linf = cv.to_affine(oth_fl, lhs)
@@ -413,7 +482,7 @@ def fused_show_verify(
     ) | (linf & comminf)
 
     # -- pairing check ------------------------------------------------------
-    acc = cv.msm_shared(oth_fl, acc_tables, acc_digits)
+    acc = cv.msm_shared_comb(oth_fl, acc_wtables, acc_mag, acc_sgn)
     jjac = cv.affine_to_jacobian(oth_fl, jpt[0], jpt[1], jinf)
     acc = cv.jadd(oth_fl, acc, jjac)
     pair_ok = verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2)
@@ -450,9 +519,9 @@ class JaxBackend(CurveBackend):
     # -- CurveBackend primitives --------------------------------------------
 
     def _msm_shared(self, spec_ops, is_fp2, bases, scalars_batch):
-        tables = _build_tables(spec_ops, bases)
-        digits = _digits(scalars_batch)
-        x, y, inf = _msm_affine_kernel(is_fp2, tables, digits)
+        wtables = _comb_tables(spec_ops, is_fp2, bases, cache=False)
+        mag, sgn = _signed_digits(scalars_batch)
+        x, y, inf = _msm_affine_kernel(is_fp2, wtables, mag, sgn)
         xs = tw.decode_batch(x)
         ys = tw.decode_batch(y)
         infs = np.asarray(inf)
@@ -479,8 +548,10 @@ class JaxBackend(CurveBackend):
         reshape = lambda t: t.reshape((B, k) + t.shape[1:])
         x, y = jax.tree_util.tree_map(reshape, (x, y))
         inf = inf.reshape(B, k)
-        digits = _digits(scalars_batch)
-        ax, ay, ainf = _msm_distinct_affine_kernel(is_fp2, x, y, inf, digits)
+        mag, sgn = _signed_digits(scalars_batch)
+        ax, ay, ainf = _msm_distinct_affine_kernel(
+            is_fp2, x, y, inf, mag, sgn
+        )
         xs = tw.decode_batch(ax)
         ys = tw.decode_batch(ay)
         infs = np.asarray(ainf)
@@ -512,7 +583,7 @@ class JaxBackend(CurveBackend):
 
     def encode_verify_batch(self, sigs, messages_list, vk, params, pad_bases_to=None):
         """Host-side encoding of a verify batch into the fused-kernel operand
-        tuple (tables, digits, s1, s2n, gtx, gty, inf1, inf2).
+        tuple (wtables, mag, sgn, s1, s2n, gtx, gty, inf1, inf2).
 
         pad_bases_to: pad the shared-base axis (with identity bases / zero
         scalars) up to this length — the sharded path needs the base count
@@ -524,8 +595,8 @@ class JaxBackend(CurveBackend):
             npad = pad_bases_to - len(bases)
             bases = bases + [None] * npad
             scalars = [row + [0] * npad for row in scalars]
-        tables = _build_tables(ctx.other, bases)
-        digits = _digits(scalars)
+        wtables = _comb_tables(ctx.other, ctx.name == "G1", bases)
+        mag, sgn = _signed_digits(scalars)
 
         sig_pts_1 = [s.sigma_1 for s in sigs]
         sig_pts_2n = [
@@ -534,7 +605,7 @@ class JaxBackend(CurveBackend):
         s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
             ctx, sig_pts_1, sig_pts_2n, params
         )
-        return (tables, digits, s1, s2n, gtx, gty, inf1, inf2)
+        return (wtables, mag, sgn, s1, s2n, gtx, gty, inf1, inf2)
 
     def _encode_sigs_and_gt(self, ctx, sig_pts_1, sig_pts_2n, params):
         """Signature-group point batches + the g_tilde constant, encoded for
@@ -553,6 +624,32 @@ class JaxBackend(CurveBackend):
             gtx = jnp.asarray(fp_encode(params.g_tilde[0]))
             gty = jnp.asarray(fp_encode(params.g_tilde[1]))
         return s1, s2n, inf1, inf2, gtx, gty
+
+    def batch_verify_async(self, sigs, messages_list, vk, params):
+        """Pipelined variant of `batch_verify`: encodes and DISPATCHES the
+        fused kernel (JAX dispatch is asynchronous), returning a zero-arg
+        finalizer that blocks on the device result. The streaming driver
+        (stream.verify_stream) overlaps the next batch's host encode with
+        the current batch's device execution through this seam."""
+        operands = self.encode_verify_batch(sigs, messages_list, vk, params)
+        bits = _fused_verify_kernel(params.ctx.name == "G1", *operands)
+
+        def finalize():
+            return [bool(b) for b in np.asarray(bits)]
+
+        return finalize
+
+    def batch_verify_grouped_async(self, sigs, messages_list, vk, params):
+        """Pipelined variant of `batch_verify_grouped` (ONE bool per batch):
+        dispatches the grouped kernel and returns a zero-arg finalizer."""
+        B = len(sigs)
+        if B == 0:
+            return lambda: True
+        if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
+            return lambda: False
+        operands = self.encode_grouped_batch(sigs, messages_list, vk, params)
+        ok = _fused_verify_grouped_kernel(params.ctx.name == "G1", *operands)
+        return lambda: bool(ok)
 
     def batch_verify(self, sigs, messages_list, vk, params):
         """Fully-fused batched PS verification (the north-star path)."""
@@ -596,18 +693,21 @@ class JaxBackend(CurveBackend):
             sigs = sigs + [sigs[0]] * pad
             messages_list = list(messages_list) + [messages_list[0]] * pad
         operands = self.encode_verify_batch(sigs, messages_list, vk, params)
-        tables, digits, s1, s2n, gtx, gty, inf1, inf2 = operands
-        rs = [secrets.randbits(128) for _ in range(Bp)]
-        rdigits = jnp.asarray(
-            np.stack([_r128_digits(r) for r in rs])[:, None, :]
-        )
+        wtables, mag, sgn, s1, s2n, gtx, gty, inf1, inf2 = operands
+        rs = [secrets.randbits(_R_RAND_BITS) for _ in range(Bp)]
+        rmag, rsgn = _signed_digits([[r] for r in rs])
+        # 128-bit r_i: only the last _R_NWIN msb-first windows are nonzero
+        rmag = rmag[:, :, _SIGNED_NWIN - _R_NWIN :]
+        rsgn = rsgn[:, :, _SIGNED_NWIN - _R_NWIN :]
         ok = _fused_verify_combined_kernel(
             params.ctx.name == "G1",
-            tables,
-            digits,
+            wtables,
+            mag,
+            sgn,
             s1,
             s2n,
-            rdigits,
+            rmag,
+            rsgn,
             gtx,
             gty,
             inf1,
@@ -636,21 +736,21 @@ class JaxBackend(CurveBackend):
 
         # Schnorr operands
         vc_bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
-        vc_tables = _build_tables(oth, vc_bases)
-        resp_digits = _digits(
+        vc_wtables = _comb_tables(oth, is_g1_ctx, vc_bases)
+        resp_mag, resp_sgn = _signed_digits(
             [[r % R for r in p.proof_vc.responses] for p in proofs]
         )
         enc_other = (
             self._encode_g2_points if is_g1_ctx else self._encode_g1_points
         )
         (jx, jy), jinf = enc_other([p.J for p in proofs])
-        cdigits_j = _digits([[c % R] for c in challenges])
+        cmag_j, csgn_j = _signed_digits([[c % R] for c in challenges])
         (commx, commy), comminf = enc_other([p.proof_vc.t for p in proofs])
 
         # pairing operands
         acc_bases = [vk.X_tilde] + [vk.Y_tilde[i] for i in revealed]
-        acc_tables = _build_tables(oth, acc_bases)
-        acc_digits = _digits(
+        acc_wtables = _comb_tables(oth, is_g1_ctx, acc_bases)
+        acc_mag, acc_sgn = _signed_digits(
             [
                 [1] + [rm[i] % R for i in revealed]
                 for rm in revealed_msgs_list
@@ -667,16 +767,19 @@ class JaxBackend(CurveBackend):
         )
         bits = _fused_show_verify_kernel(
             is_g1_ctx,
-            vc_tables,
-            resp_digits,
+            vc_wtables,
+            resp_mag,
+            resp_sgn,
             ((jx, jy)),
             jinf,
-            cdigits_j,
+            cmag_j,
+            csgn_j,
             commx,
             commy,
             comminf,
-            acc_tables,
-            acc_digits,
+            acc_wtables,
+            acc_mag,
+            acc_sgn,
             s1,
             s2n,
             gtx,
@@ -710,13 +813,34 @@ class JaxBackend(CurveBackend):
             return True
         if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
             return False
+        operands = self.encode_grouped_batch(sigs, messages_list, vk, params)
+        ok = _fused_verify_grouped_kernel(params.ctx.name == "G1", *operands)
+        return bool(ok)
+
+    def encode_grouped_batch(
+        self, sigs, messages_list, vk, params, pad_batch_to=None
+    ):
+        """Host-side encoding for the grouped verify kernel: pads the batch
+        to a power of two (>= pad_batch_to if given — the sharded path needs
+        the batch divisible by the mesh's dp extent), samples the combiner
+        scalars, and recodes all scalar rows to signed 5-bit windows.
+        Returns the fused_verify_grouped operand tuple (everything after
+        sig_is_g1). Callers must have rejected empty batches and identity
+        sigmas already."""
+        import secrets
+
+        B = len(sigs)
+        q = len(vk.Y_tilde)
         Bp = 1 << max(1, (B - 1).bit_length())
+        if pad_batch_to is not None:
+            while Bp < pad_batch_to:
+                Bp *= 2
         pad = Bp - B
         if pad:
             sigs = list(sigs) + [sigs[0]] * pad
             messages_list = list(messages_list) + [messages_list[0]] * pad
         ctx = params.ctx
-        rs = [secrets.randbits(128) for _ in range(Bp)]
+        rs = [secrets.randbits(_R_RAND_BITS) for _ in range(Bp)]
         rows = [rs] + [
             [r * (msgs[j] % R) % R for r, msgs in zip(rs, messages_list)]
             for j in range(q)
@@ -726,11 +850,18 @@ class JaxBackend(CurveBackend):
         recoded = [fr_digits_signed_np(row) for row in rows]
         cmag = jnp.asarray(np.stack([m for m, _ in recoded]))
         csgn = jnp.asarray(np.stack([s for _, s in recoded]))  # [q+1, Bp, 52]
-        # r_i are 128-bit: only the last 27 msb-first windows of the r-row
-        # can be nonzero — slice so the -sigma_2 MSM runs a short schedule
-        assert not recoded[0][0][:, : 52 - 27].any()
-        rmag = cmag[:1, :, 52 - 27 :]
-        rsgn = csgn[:1, :, 52 - 27 :]
+        # r_i are _R_RAND_BITS-bit: only the last _R_NWIN msb-first windows
+        # of the r-row can be nonzero — slice so the -sigma_2 MSM runs a
+        # short schedule. A real check (not assert: must survive python -O)
+        # so a widened sampler can never silently drop top windows.
+        nwin = cmag.shape[-1]
+        if recoded[0][0][:, : nwin - _R_NWIN].any():
+            raise ValueError(
+                "combiner scalar exceeds %d bits: top windows nonzero"
+                % _R_RAND_BITS
+            )
+        rmag = cmag[:1, :, nwin - _R_NWIN :]
+        rsgn = csgn[:1, :, nwin - _R_NWIN :]
 
         s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
             ctx,
@@ -747,22 +878,7 @@ class JaxBackend(CurveBackend):
 
             ox = jnp.asarray(fp_encode_batch([p[0] for p in others]))
             oy = jnp.asarray(fp_encode_batch([p[1] for p in others]))
-        ok = _fused_verify_grouped_kernel(
-            ctx.name == "G1",
-            s1,
-            s2n,
-            inf1,
-            inf2,
-            cmag,
-            csgn,
-            rmag,
-            rsgn,
-            ox,
-            oy,
-            gtx,
-            gty,
-        )
-        return bool(ok)
+        return (s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn, ox, oy, gtx, gty)
 
     def batch_verify_sharded(self, sigs, messages_list, vk, params, mesh, **kw):
         """Multi-chip variant: dp-sharded credentials, tp-sharded MSM bases
@@ -770,5 +886,17 @@ class JaxBackend(CurveBackend):
         from . import shard
 
         return shard.batch_verify_sharded(
+            self, sigs, messages_list, vk, params, mesh, **kw
+        )
+
+    def batch_verify_grouped_sharded(
+        self, sigs, messages_list, vk, params, mesh, **kw
+    ):
+        """Multi-chip HEADLINE variant: the attribute-grouped one-bool
+        verify with the credential batch dp-sharded over `mesh` and the
+        MSM accumulators combined across devices (see tpu/shard.py)."""
+        from . import shard
+
+        return shard.batch_verify_grouped_sharded(
             self, sigs, messages_list, vk, params, mesh, **kw
         )

@@ -148,12 +148,6 @@ def to_affine(fl, p):
     return fl.mul(X, zinv), fl.mul(Y, zinv), fl.is_zero(Z)
 
 
-def gather_point(table, idx):
-    """table: pytree with leading [n] axis; idx: int array [...] ->
-    pytree with leading idx-shape."""
-    return jax.tree_util.tree_map(lambda t: jnp.take(t, idx, axis=0), table)
-
-
 def affine_to_jacobian(fl, x, y, inf):
     """Affine pytree + infinity mask -> projective ((x,y,1) / (0,1,0))."""
     one = fl.ones(inf.shape)
@@ -224,24 +218,100 @@ def fold_points(fl, pts, n, axis_offset=0, chunk=16):
     return jax.tree_util.tree_map(lambda t: jnp.take(t, 0, axis=ax), pts)
 
 
-def msm_distinct(fl, x, y, inf, digits):
-    """Windowed MSM over per-row bases (the issuance shape: every credential
-    request carries its own ciphertext points — reference signature.rs:400-428
-    — so there is no shared table).
+def fold_points_any(fl, pts, n, axis_offset=0):
+    """Sum a pytree of n points (ANY n >= 1) along the (axis_offset)-th
+    leading axis with n-1 lane-adds: static binary decomposition of n into
+    power-of-two blocks, each folded by fold_points, partials chain-added."""
+    ax = axis_offset
+    if n == 1:
+        return jax.tree_util.tree_map(lambda t: jnp.take(t, 0, axis=ax), pts)
+    acc = None
+    off = 0
+    for bit in range(n.bit_length() - 1, -1, -1):
+        blk = 1 << bit
+        if not n & blk:
+            continue
+        part = jax.tree_util.tree_map(
+            lambda t: jax.lax.slice_in_dim(t, off, off + blk, axis=ax), pts
+        )
+        folded = fold_points(fl, part, blk, axis_offset=ax)
+        acc = folded if acc is None else jadd(fl, acc, folded)
+        off += blk
+    return acc
 
-    x, y, inf: affine points [..., k]; digits: uint [..., k, nwin] 4-bit
-    windows, most significant first (zero scalars -> all-zero digits).
-    Returns a projective accumulator pytree with leading dims [...]."""
-    tables = build_tables_device(fl, x, y, inf)
+
+def build_comb_tables(fl, tables17, nwin):
+    """Fixed-base comb window tables for the shared-base MSM.
+
+    tables17: projective multiples 0..16 as a pytree with leading [k, 17]
+    (entry 0 = identity). Returns leading [k, nwin, 17] where entry
+    (j, w, d) = d * 32^(nwin-1-w) * base_j — i.e. the w-th MS-first signed
+    5-bit window digit's contribution is a pure table lookup, so the MSM
+    itself needs NO doublings. The scaling scan runs on the tiny [k, 17]
+    shape (5 doublings per window), so table build cost is negligible
+    against the [B]-wide MSM; per-verkey tables are cached device-side by
+    the backend."""
+
+    def body(carry, _):
+        nxt = carry
+        for _ in range(5):
+            nxt = jdouble(fl, nxt)
+        return nxt, carry  # emit BEFORE scaling: row w = 32^w * tables
+
+    _, rows = jax.lax.scan(body, tables17, None, length=nwin)
+    # rows: [nwin(lsb-first), k, 17, L] -> msb-first, then [k, nwin, 17, L]
+    return jax.tree_util.tree_map(
+        lambda t: jnp.moveaxis(jnp.flip(t, axis=0), 0, 1), rows
+    )
+
+
+def msm_shared_comb(fl, wtables, mag, sgn):
+    """Fixed-base comb MSM over shared bases: gather one table entry per
+    (credential, base, window) and fold — 0 doublings, k*nwin-1 lane-adds
+    per credential, all at full [B] width (no sequential window scan).
+
+    wtables: comb tables from build_comb_tables, leading [k, nwin, 17];
+    mag/sgn: signed 5-bit window digits [B, k, nwin] (msb-first,
+    digit = (-1)^sgn * mag, mag <= 16; zero scalars -> all-zero digits).
+    Returns a projective accumulator pytree with leading [B]."""
+    B, k, nwin = mag.shape
+    jidx = jnp.arange(k)[None, :, None]
+    widx = jnp.arange(nwin)[None, None, :]
+
+    def leaf(t):  # [k, nwin, 17, L...] -> [B, k, nwin, L...]
+        return t[jidx, widx, mag]
+
+    X, Y, Z = (
+        jax.tree_util.tree_map(leaf, wtables[0]),
+        jax.tree_util.tree_map(leaf, wtables[1]),
+        jax.tree_util.tree_map(leaf, wtables[2]),
+    )
+    Y = fl.select(sgn, fl.neg(Y), Y)
+    flat = jax.tree_util.tree_map(
+        lambda t: t.reshape((B, k * nwin) + t.shape[3:]), (X, Y, Z)
+    )
+    return fold_points_any(fl, flat, k * nwin, axis_offset=1)
+
+
+def msm_distinct_signed(fl, x, y, inf, mag, sgn):
+    """Signed 5-bit windowed MSM over per-row bases (the issuance/show
+    shape: per-credential points, so tables must be built on device).
+
+    x, y, inf: affine points [..., k]; mag/sgn: [..., k, nwin] signed
+    5-bit window digits, msb first (digit = (-1)^sgn * mag, mag <= 16).
+    52-window Horner (5 doublings + k adds per window) vs the unsigned
+    4-bit schedule's 64 windows. Returns a projective accumulator pytree
+    with leading dims [...]."""
+    tables = build_tables_device(fl, x, y, inf, entries=17)
     k = inf.shape[-1]
     acc = jinfinity(fl, inf.shape[:-1])
 
     def body(acc, dw):
-        # dw: [..., k] digits of this window
-        acc = jax.lax.fori_loop(0, 4, lambda _, a: jdouble(fl, a), acc)
+        mw, sw = dw  # each [..., k]
+        acc = jax.lax.fori_loop(0, 5, lambda _, a: jdouble(fl, a), acc)
 
         def add_base(j, a):
-            idx = jnp.take(dw, j, axis=-1)  # [...]
+            idx = jnp.take(mw, j, axis=-1)  # [...]
             entry = jax.tree_util.tree_map(
                 lambda t: jnp.squeeze(
                     jnp.take_along_axis(
@@ -253,47 +323,19 @@ def msm_distinct(fl, x, y, inf, digits):
                 ),
                 tables,
             )
+            sj = jnp.take(sw, j, axis=-1)
+            ex, ey, ez = entry
+            entry = (ex, fl.select(sj, fl.neg(ey), ey), ez)
             return jadd(fl, a, entry)
 
         acc = jax.lax.fori_loop(0, k, add_base, acc)
         return acc, None
 
-    acc, _ = jax.lax.scan(body, acc, jnp.moveaxis(digits, -1, 0))
+    acc, _ = jax.lax.scan(
+        body,
+        acc,
+        (jnp.moveaxis(mag, -1, 0), jnp.moveaxis(sgn, -1, 0)),
+    )
     return acc
 
 
-def msm_shared(fl, tables, digits):
-    """Windowed shared-base MSM.
-
-    tables: pytree (X, Y, Z) of arrays [k, 16, ...limbs...] — per-base
-      projective multiples 0..15 (entry 0 = identity (0,1,0)), precomputed
-      host-side from the spec ops so table contents are trusted.
-    digits: uint array [B, k, nwin] — 4-bit windows, most significant first.
-    Returns a projective accumulator pytree with leading [B].
-
-    Compile-size discipline: the window loop is a `scan` and the doubling /
-    per-base-add loops are `fori_loop`s, so jdouble and jadd are each
-    compiled exactly ONCE regardless of window count or base count.
-    """
-    B, k, nwin = digits.shape
-    acc = jinfinity(fl, (B,))
-
-    def body(acc, dw):
-        # dw: [B, k] digits for this window
-        acc = jax.lax.fori_loop(0, 4, lambda _, a: jdouble(fl, a), acc)
-
-        def add_base(j, a):
-            row = jax.tree_util.tree_map(
-                lambda t: jax.lax.dynamic_index_in_dim(
-                    t, j, axis=0, keepdims=False
-                ),
-                tables,
-            )
-            entry = gather_point(row, jnp.take(dw, j, axis=1))
-            return jadd(fl, a, entry)
-
-        acc = jax.lax.fori_loop(0, k, add_base, acc)
-        return acc, None
-
-    acc, _ = jax.lax.scan(body, acc, jnp.moveaxis(digits, -1, 0))
-    return acc

@@ -153,17 +153,6 @@ def fp_decode_batch(arr):
     ]
 
 
-def fr_to_digits(k, window=4):
-    """Fr scalar -> fixed-length window-digit vector (np.uint32), most
-    significant digit first — the MSM window schedule."""
-    k = int(k) % R
-    ndig = (256 + window - 1) // window
-    return np.array(
-        [(k >> (window * i)) & ((1 << window) - 1) for i in range(ndig - 1, -1, -1)],
-        dtype=np.uint32,
-    )
-
-
 def fr_digits_signed_np(scalars, nwin=52):
     """[n] iterable of ints -> (mag uint8 [n, nwin], neg bool [n, nwin])
     signed 5-bit window digits, msb first: k = sum_w d_w * 32^w with
@@ -192,15 +181,3 @@ def fr_digits_signed_np(scalars, nwin=52):
         neg[:, nwin - 1 - w] = d < 0
     assert not c.any()  # Fr < 2^255: the top window absorbs every carry
     return mag, neg
-
-
-def fr_digits_np(scalars):
-    """[n] iterable of ints -> np.uint32 [n, 64] 4-bit window digits, msb
-    first. Vectorized (bytes -> nibble split) — the per-scalar Python-loop
-    version costs ~0.5 ms/scalar, which dominates host encode at batch 1k."""
-    buf = b"".join((int(s) % R).to_bytes(32, "big") for s in scalars)
-    bs = np.frombuffer(buf, dtype=np.uint8).reshape(-1, 32)
-    out = np.empty((bs.shape[0], 64), dtype=np.uint32)
-    out[:, 0::2] = bs >> 4
-    out[:, 1::2] = bs & 0xF
-    return out

@@ -23,6 +23,21 @@ from . import tower as tw
 # Static bit schedule of |BLS_X|, msb first, leading bit dropped.
 _XBITS = jnp.array([int(b) for b in bin(-BLS_X)[2:]][1:], dtype=jnp.int32)
 
+# Segment decomposition of the same schedule for the Miller loop: |BLS_X|
+# has only 5 set bits after the leading one, so instead of computing the
+# addition step on every iteration and select-masking it away (the r2
+# design: ~58 of 63 add steps + line muls thrown away), run scans of pure
+# doubling steps between the STATIC set-bit positions and unroll the 5
+# double+add steps. _SEG_ZEROS[i] = number of pure-double steps before the
+# i-th set bit; _TRAILING = pure-double steps after the last set bit.
+_SEG_ZEROS, _TRAILING = [], 0
+for _b in [int(b) for b in bin(-BLS_X)[2:]][1:]:
+    if _b:
+        _SEG_ZEROS.append(_TRAILING)
+        _TRAILING = 0
+    else:
+        _TRAILING += 1
+
 
 def _proj_double_step(T):
     """Mirror of ops.pairing.proj_double_step on Fp2 limb pytrees."""
@@ -90,21 +105,25 @@ def multi_miller_loop(px, py, qx, qy, valid):
     T0 = (qx, qy, tw.fp2_ones(shape))
     f0 = tw.fp12_ones(shape)
 
-    def body(carry, bit):
+    def dbl_body(carry, _):
         f, T = carry
         T, line = _proj_double_step(T)
         f = tw.mul_line(tw.fp12_sq(f), _eval_line(line, px, py))
-        Ta, la = _proj_add_step(T, (qx, qy))
-        fa = tw.mul_line(f, _eval_line(la, px, py))
-        use = bit == 1
-        f = tw.fp12_select(jnp.broadcast_to(use, shape), fa, f)
-        T = tuple(
-            tw.fp2_select(jnp.broadcast_to(use, shape), a, b)
-            for a, b in zip(Ta, T)
-        )
         return (f, T), None
 
-    (f, _), _ = lax.scan(body, (f0, T0), _XBITS)
+    carry = (f0, T0)
+    for nz in _SEG_ZEROS:
+        if nz:
+            carry, _ = lax.scan(dbl_body, carry, None, length=nz)
+        # the set-bit step, unrolled: double + add, no masks
+        (carry, _) = dbl_body(carry, None)
+        f, T = carry
+        T, la = _proj_add_step(T, (qx, qy))
+        f = tw.mul_line(f, _eval_line(la, px, py))
+        carry = (f, T)
+    if _TRAILING:
+        carry, _ = lax.scan(dbl_body, carry, None, length=_TRAILING)
+    f, _ = carry
     f = tw.fp12_conj(f)  # x < 0
     f = tw.fp12_select(valid, f, tw.fp12_ones(shape))
     # fold the pairs axis (last leading dim) by multiplication
@@ -121,11 +140,73 @@ def _index_fp12(f, i):
     return jax.tree_util.tree_map(lambda t: t[..., i, :], f)
 
 
+def _mask_line(line, valid):
+    """Select the identity line (1, 0, 0) on invalid lanes so a dead pair
+    contributes the factor 1 to the merged accumulator (the generic loop's
+    post-hoc fp12 select, pushed down to the sparse element)."""
+    lA, lB, lC = line
+    one = tw.fp2_ones(valid.shape)
+    zero = tw.fp2_zeros(valid.shape)
+    return (
+        tw.fp2_select(valid, lA, one),
+        tw.fp2_select(valid, lB, zero),
+        tw.fp2_select(valid, lC, zero),
+    )
+
+
+def miller_two_pairs_shared_q2(
+    px1, py1, qx1, qy1, valid1, px2, py2, q2x, q2y, valid2
+):
+    """Miller product of exactly two pairs per credential with pair 2's
+    TWIST point shared across the batch — the verify shape
+    e(sigma_1_i, acc_i) * e(-sigma_2_i, g_tilde) in the G1 assignment.
+
+    Two structural wins over the generic [B, 2] pair-set loop:
+      - the fp12 accumulator is [B]-shaped (one per credential, both
+        pairs' lines multiplied in per step) instead of [B, 2] + final
+        fold — halving the dominant fp12_sq/mul_line work;
+      - pair 2's T-ladder and line COEFFICIENTS run once at scalar shape
+        (g_tilde is one point); only the two line evaluations at
+        (px2_i, py2_i) are per-credential.
+    Dead pairs contribute the factor 1 via line masking (_mask_line)."""
+    shape = valid1.shape
+    T1 = (qx1, qy1, tw.fp2_ones(shape))
+    T2 = (q2x, q2y, tw.fp2_ones(()))
+    f0 = tw.fp12_ones(shape)
+
+    def fuse(f, l1, l2):
+        f = tw.mul_line(f, _mask_line(_eval_line(l1, px1, py1), valid1))
+        return tw.mul_line(f, _mask_line(_eval_line(l2, px2, py2), valid2))
+
+    def dbl_body(carry, _):
+        f, T1, T2 = carry
+        T1, l1 = _proj_double_step(T1)
+        T2, l2 = _proj_double_step(T2)
+        f = fuse(tw.fp12_sq(f), l1, l2)
+        return (f, T1, T2), None
+
+    carry = (f0, T1, T2)
+    for nz in _SEG_ZEROS:
+        if nz:
+            carry, _ = lax.scan(dbl_body, carry, None, length=nz)
+        carry, _ = dbl_body(carry, None)
+        f, T1, T2 = carry
+        T1, l1 = _proj_add_step(T1, (qx1, qy1))
+        T2, l2 = _proj_add_step(T2, (q2x, q2y))
+        carry = (fuse(f, l1, l2), T1, T2)
+    if _TRAILING:
+        carry, _ = lax.scan(dbl_body, carry, None, length=_TRAILING)
+    return tw.fp12_conj(carry[0])  # x < 0
+
+
 def _pow_x_abs(m):
-    """m^{|BLS_X|} in the cyclotomic subgroup (scan over the static bits)."""
+    """m^{|BLS_X|} in the cyclotomic subgroup (scan over the static bits).
+    Squarings use the Granger-Scott cyclotomic form (tw.fp12_cyclo_sq,
+    30 base lanes vs fp12_sq's 36) — sound because every value in the
+    chain is a power of the cyclotomic input."""
 
     def body(acc, bit):
-        acc = tw.fp12_sq(acc)
+        acc = tw.fp12_cyclo_sq(acc)
         accm = tw.fp12_mul(acc, m)
         acc = tw.fp12_select(
             jnp.broadcast_to(bit == 1, _leading(acc)), accm, acc
@@ -156,7 +237,7 @@ def final_exp(f):
         tw.fp12_mul(_pow_x_neg(_pow_x_neg(t2)), tw.fp12_frobenius2(t2)),
         tw.fp12_conj(t2),
     )
-    return tw.fp12_mul(t3, tw.fp12_mul(tw.fp12_sq(m), m))
+    return tw.fp12_mul(t3, tw.fp12_mul(tw.fp12_cyclo_sq(m), m))
 
 
 def pairing_product_is_one(px, py, qx, qy, valid):

@@ -276,6 +276,75 @@ def fp12_sq(a):
     return (c0, c1)
 
 
+def fp12_cyclo_sq(a):
+    """Granger–Scott cyclotomic squaring — valid ONLY for elements of the
+    cyclotomic subgroup G_{Phi12}(p) (everything after the easy part of the
+    final exponentiation). For such elements the square decomposes into
+    three Fp4 squarings over the pairs (z0,z1)=(c00,c11), (z2,z3)=
+    (c10,c02), (z4,z5)=(c01,c12) with Fp4 = Fp2[s]/(s^2 - xi):
+
+      fp4_sq(x, y) = (x^2 + xi y^2, 2xy)           [3 fp2 squarings]
+      z0' = 3A0 - 2z0   z1' = 3B0 + 2z1            [A_i, B_i = fp4 parts]
+      z4' = 3A1 - 2z4   z5' = 3B1 + 2z5
+      z2' = 3 xi B2 + 2z2   z3' = 3A2 - 2z3
+
+    Cost: 9 fp2 squarings (18 base products) + 12 compress muls, all in ONE
+    stacked contraction = 30 base lanes, vs fp12_sq's 36 — and unlike
+    fp12_sq the additive tail reuses the INPUT components, so each input
+    component is compressed (one Montgomery mul by 1) to keep the lazy
+    value/limb class bounded across unbounded squaring chains (the scan in
+    pairing._pow_x_abs runs up to 31 consecutive squarings with no
+    intervening normalizing multiply):
+      output limb weight <= 3*(3*132) + 2*132 = 1452 << L_LAZY = 2^17,
+      output |value| <= 3*2p + 2*0.66p < 8p << V_LAZY = 1024p,
+    a fixed point of the recursion (outputs are built only from fresh mul
+    outputs and compressed inputs)."""
+    (c00, c01, c02), (c10, c11, c12) = a
+    pairs = [(c00, c11), (c10, c02), (c01, c12)]
+    lhs, rhs = [], []
+    for x, y in pairs:
+        for e in (x, y, fp2_add(x, y)):
+            # fp2_sq(e) = ((e0+e1)(e0-e1), 2 e0 e1): two base products
+            lhs += [fp.add(e[0], e[1]), e[0]]
+            rhs += [fp.sub(e[0], e[1]), e[1]]
+    one = fp.ones_mont()
+    for comp in (c00, c11, c10, c02, c01, c12):
+        lhs += [comp[0], comp[1]]
+        rhs += [one, one]
+    prods = fp.mul_stack(lhs, rhs)
+    sq = []  # the 9 fp2 squares, pair-major
+    for i in range(9):
+        sq.append((prods[2 * i], fp.add(prods[2 * i + 1], prods[2 * i + 1])))
+    cc = []  # compressed input components, in the order fed above
+    for j in range(6):
+        cc.append((prods[18 + 2 * j], prods[18 + 2 * j + 1]))
+    z0c, z1c, z2c, z3c, z4c, z5c = cc  # (c00, c11, c10, c02, c01, c12)
+
+    def fp4_parts(i):
+        tx, ty, ts = sq[3 * i], sq[3 * i + 1], sq[3 * i + 2]
+        A = fp2_add(tx, fp2_mul_xi(ty))
+        B = fp2_sub(fp2_sub(ts, tx), ty)
+        return A, B
+
+    A0, B0 = fp4_parts(0)
+    A1, B1 = fp4_parts(1)
+    A2, B2 = fp4_parts(2)
+
+    def t3m2(t, z):  # 3t - 2z
+        return fp2_sub(fp2_mul_small(t, 3), fp2_mul_small(z, 2))
+
+    def t3p2(t, z):  # 3t + 2z
+        return fp2_add(fp2_mul_small(t, 3), fp2_mul_small(z, 2))
+
+    z0p = t3m2(A0, z0c)
+    z1p = t3p2(B0, z1c)
+    z4p = t3m2(A1, z4c)
+    z5p = t3p2(B1, z5c)
+    z2p = t3p2(fp2_mul_xi(B2), z2c)
+    z3p = t3m2(A2, z3c)
+    return ((z0p, z4p, z3p), (z2p, z1p, z5p))
+
+
 def fp12_conj(a):
     return (a[0], fp6_neg(a[1]))
 

@@ -18,6 +18,7 @@
 // as all-zero bytes (not a curve point: 0^3 + 4 != 0); scalars = 32 bytes
 // little-endian canonical Fr.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -55,21 +56,22 @@ static inline bool fp_eq_raw(const Fp &a, const Fp &b) {
   return t == 0;
 }
 
-static inline int fp_cmp_p(const Fp &a) {  // a ? p  -> -1,0,1
-  for (int i = 5; i >= 0; i--) {
-    if (a.v[i] < PL[i]) return -1;
-    if (a.v[i] > PL[i]) return 1;
-  }
-  return 0;
-}
+// Branchless normalization: every conditional reduction below is a masked
+// select, so field-op timing is independent of VALUES (not just of the MSM
+// schedule) — the property the const-time issuance path (msm_row_ct)
+// inherits; the reference gets the same from amcl's CT normalization.
 
-static inline void fp_sub_p(Fp &a) {
+// r = r - p if (force || r >= p), as one masked pass
+static inline void fp_cond_sub_p(Fp &r, u64 force) {
+  u64 t[6];
   u128 borrow = 0;
   for (int i = 0; i < 6; i++) {
-    u128 d = (u128)a.v[i] - PL[i] - borrow;
-    a.v[i] = (u64)d;
+    u128 d = (u128)r.v[i] - PL[i] - borrow;
+    t[i] = (u64)d;
     borrow = (d >> 64) & 1;
   }
+  u64 mask = (u64)0 - (force | (u64)(1 - (u64)borrow));  // sub if no borrow
+  for (int i = 0; i < 6; i++) r.v[i] = (r.v[i] & ~mask) | (t[i] & mask);
 }
 
 static inline Fp fp_add(const Fp &a, const Fp &b) {
@@ -80,7 +82,7 @@ static inline Fp fp_add(const Fp &a, const Fp &b) {
     r.v[i] = (u64)s;
     carry = s >> 64;
   }
-  if (carry || fp_cmp_p(r) >= 0) fp_sub_p(r);
+  fp_cond_sub_p(r, (u64)carry);
   return r;
 }
 
@@ -92,22 +94,27 @@ static inline Fp fp_sub(const Fp &a, const Fp &b) {
     r.v[i] = (u64)d;
     borrow = (d >> 64) & 1;
   }
-  if (borrow) {
-    u128 carry = 0;
-    for (int i = 0; i < 6; i++) {
-      u128 s = (u128)r.v[i] + PL[i] + carry;
-      r.v[i] = (u64)s;
-      carry = s >> 64;
-    }
+  // add p back iff it underflowed, masked
+  u64 mask = (u64)0 - (u64)borrow;
+  u128 carry = 0;
+  for (int i = 0; i < 6; i++) {
+    u128 s = (u128)r.v[i] + (PL[i] & mask) + carry;
+    r.v[i] = (u64)s;
+    carry = s >> 64;
   }
   return r;
 }
 
 static inline Fp fp_neg(const Fp &a) {
-  if (fp_is_zero_raw(a)) return a;
+  // p - a, then zero the result iff a == 0 (masked, branch-free)
   Fp p;
   memcpy(p.v, PL, sizeof(PL));
-  return fp_sub(p, a);
+  Fp r = fp_sub(p, a);
+  u64 nz = 0;
+  for (int i = 0; i < 6; i++) nz |= a.v[i];
+  u64 mask = (u64)0 - ((nz | ((u64)0 - nz)) >> 63);  // -1 iff a != 0
+  for (int i = 0; i < 6; i++) r.v[i] &= mask;
+  return r;
 }
 
 static inline Fp fp_dbl(const Fp &a) { return fp_add(a, a); }
@@ -140,7 +147,7 @@ static inline Fp fp_mul(const Fp &a, const Fp &b) {
   }
   Fp r;
   memcpy(r.v, t, 48);
-  if (t[6] || fp_cmp_p(r) >= 0) fp_sub_p(r);
+  fp_cond_sub_p(r, (u64)(t[6] != 0));
   return r;
 }
 
@@ -625,39 +632,183 @@ static Jac<F> msm_row(const std::vector<Jac<F>> &tables, const Scalar *s,
   return acc;
 }
 
-// Fixed-window masked-lookup variant for secret scalars (issuance side:
-// const-time MSM call sites signature.rs:157,424-428). Every table entry is
-// read and every add executed; selection is by byte masks.
+// Pippenger bucket MSM, var-time, for ONE large MSM over distinct points
+// (the reference's multi_scalar_mul_var_time surface, signature.rs:513,521:
+// Verkey.aggregate at large thresholds and any future big-MSM workload).
+// Complexity ~ nwin * (n adds + 2^c bucket-combine adds) vs the windowed
+// row schedule's 64*(4 dbl + n adds); wins once n is large enough that the
+// bucket combine amortizes (crossover measured in BASELINE.md).
+
+static inline unsigned scalar_bits(const Scalar &s, int lo, int c) {
+  unsigned v = 0;
+  for (int b = 0; b < c; b++) {
+    int idx = lo + b;
+    if (idx >= 256) break;
+    v |= ((unsigned)((s.v[idx / 64] >> (idx % 64)) & 1)) << b;
+  }
+  return v;
+}
+
 template <typename F>
-static Jac<F> msm_row_ct(const std::vector<Jac<F>> &tables, const Scalar *s,
-                         int k) {
+static Jac<F> msm_pippenger(const F *xs, const F *ys, const bool *inf,
+                            const Scalar *s, int n) {
+  int c = n < 128 ? 4 : (n < 1024 ? 6 : (n < 8192 ? 8 : 12));
+  int nwin = (255 + c) / c;
+  Jac<F> result = jac_inf<F>();
+  std::vector<Jac<F>> buckets((size_t)1 << c);
+  for (int w = nwin - 1; w >= 0; w--) {
+    if (w != nwin - 1)
+      for (int d = 0; d < c; d++) result = jac_double(result);
+    std::fill(buckets.begin(), buckets.end(), jac_inf<F>());
+    for (int i = 0; i < n; i++) {
+      if (inf[i]) continue;
+      unsigned dg = scalar_bits(s[i], w * c, c);
+      if (dg) buckets[dg] = jac_add_affine(buckets[dg], xs[i], ys[i], false);
+    }
+    // running-sum combine: sum_b b * bucket[b] in 2*(2^c - 1) adds
+    Jac<F> run = jac_inf<F>(), sum = jac_inf<F>();
+    for (int b = (1 << c) - 1; b >= 1; b--) {
+      run = jac_add(run, buckets[b]);
+      sum = jac_add(sum, run);
+    }
+    result = jac_add(result, sum);
+  }
+  return result;
+}
+
+// ---------------------------------------------------------------------------
+// Complete projective arithmetic (Renes-Costello-Batina 2015, a = 0) — the
+// SAME branch-free formulas the TPU kernels use (tpu/curve.py jadd/jdouble):
+// valid for EVERY input pair including the identity (0 : 1 : 0), so the
+// const-time MSM below has no secret-dependent branch anywhere.
+// b3 = 3b: 12 for G1 (b = 4), 12*(u+1) for the twist (b' = 4(u+1)).
+// ---------------------------------------------------------------------------
+
+template <typename F>
+struct Proj {
+  F X, Y, Z;
+};
+
+static inline Fp b3_of(const Fp &t) { return fp_mul_small(t, 12); }
+static inline Fp2 b3_of(const Fp2 &t) {
+  return fp2_mul_small(fp2_mul_xi(t), 12);
+}
+
+template <typename F>
+static inline Proj<F> proj_inf() {
+  return {FieldOps<F>::zero(), FieldOps<F>::one(), FieldOps<F>::zero()};
+}
+
+// RCB 2015 Alg. 7 (a = 0): complete projective addition, 12 muls, no
+// branches (mirrors tpu/curve.py jadd).
+template <typename F>
+static Proj<F> proj_add_complete(const Proj<F> &p, const Proj<F> &q) {
   using O = FieldOps<F>;
-  Jac<F> acc = jac_inf<F>();
+  F t0 = O::mul(p.X, q.X);
+  F t1 = O::mul(p.Y, q.Y);
+  F t2 = O::mul(p.Z, q.Z);
+  F m3 = O::mul(O::add(p.X, p.Y), O::add(q.X, q.Y));
+  F m4 = O::mul(O::add(p.Y, p.Z), O::add(q.Y, q.Z));
+  F m5 = O::mul(O::add(p.X, p.Z), O::add(q.X, q.Z));
+  F t3 = O::sub(O::sub(m3, t0), t1);  // X1Y2 + X2Y1
+  F t4 = O::sub(O::sub(m4, t1), t2);  // Y1Z2 + Y2Z1
+  F t5 = O::sub(O::sub(m5, t0), t2);  // X1Z2 + X2Z1
+  F b3t2 = b3_of(t2);
+  F y3 = b3_of(t5);
+  F t0_3 = O::add(O::add(t0, t0), t0);  // 3 X1X2
+  F z3s = O::add(t1, b3t2);
+  F t1m = O::sub(t1, b3t2);
+  F x3a = O::mul(t4, y3);
+  F t2c = O::mul(t3, t1m);
+  F y3b = O::mul(y3, t0_3);
+  F t1d = O::mul(t1m, z3s);
+  F t0e = O::mul(t0_3, t3);
+  F z3f = O::mul(z3s, t4);
+  return {O::sub(t2c, x3a), O::add(t1d, y3b), O::add(z3f, t0e)};
+}
+
+// RCB 2015 Alg. 9 (a = 0): complete projective doubling, 9 muls, no
+// branches (mirrors tpu/curve.py jdouble).
+template <typename F>
+static Proj<F> proj_double_complete(const Proj<F> &p) {
+  using O = FieldOps<F>;
+  F a_ = O::mul(p.Y, p.Y);
+  F b_ = O::mul(p.Y, p.Z);
+  F c_ = O::mul(p.Z, p.Z);
+  F xy = O::mul(p.X, p.Y);
+  F cb = b3_of(c_);
+  F e8 = O::small(a_, 8);
+  F y3s = O::add(a_, cb);
+  F t0m = O::sub(a_, O::small(cb, 3));
+  F x3p = O::mul(cb, e8);
+  F z3 = O::mul(b_, e8);
+  F y2m = O::mul(t0m, y3s);
+  F x3m = O::mul(t0m, xy);
+  return {O::add(x3m, x3m), O::add(x3p, y2m), z3};
+}
+
+template <typename F>
+static void proj_to_affine(const Proj<F> &p, F &x, F &y, bool &inf) {
+  using O = FieldOps<F>;
+  if (O::is_zero(p.Z)) {
+    inf = true;
+    x = O::zero();
+    y = O::zero();
+    return;
+  }
+  inf = false;
+  F zi = O::inv(p.Z);
+  x = O::mul(p.X, zi);
+  y = O::mul(p.Y, zi);
+}
+
+// Fixed-window masked-lookup MSM for secret scalars (issuance side:
+// const-time MSM call sites signature.rs:157,424-428). Every table entry
+// is read, every add/double executed through the COMPLETE formulas above —
+// no secret-dependent branch or memory access anywhere in the schedule
+// (the former Jacobian-add edge-case branches are gone; VERDICT r2 item 7).
+// Tables are public (wire-data bases), so their var-time build is fine.
+template <typename F>
+static Proj<F> msm_row_ct(const std::vector<Proj<F>> &tables, const Scalar *s,
+                          int k) {
+  Proj<F> acc = proj_inf<F>();
   for (int w = 0; w < 64; w++) {
     if (w) {
-      acc = jac_double(acc);
-      acc = jac_double(acc);
-      acc = jac_double(acc);
-      acc = jac_double(acc);
+      acc = proj_double_complete(acc);
+      acc = proj_double_complete(acc);
+      acc = proj_double_complete(acc);
+      acc = proj_double_complete(acc);
     }
     for (int j = 0; j < k; j++) {
       unsigned d = scalar_window(s[j], w);
       // masked gather of tables[j][d]
-      Jac<F> e = jac_inf<F>();
+      Proj<F> e = proj_inf<F>();
       const u64 *src0 = (const u64 *)&tables[(size_t)j * 16];
       u64 *dst = (u64 *)&e;
-      size_t words = sizeof(Jac<F>) / 8;
+      size_t words = sizeof(Proj<F>) / 8;
       for (unsigned t = 0; t < 16; t++) {
         u64 mask = (u64)0 - (u64)(t == d);
         const u64 *src = src0 + (size_t)t * words;
-        for (size_t q = 0; q < words; q++) dst[q] = (dst[q] & ~mask) | (src[q] & mask);
+        for (size_t q = 0; q < words; q++)
+          dst[q] = (dst[q] & ~mask) | (src[q] & mask);
       }
-      acc = jac_add(acc, e);  // NOTE: add itself branches on edge cases;
-      // full constant-time completeness is documented as a caveat in
-      // coconut_tpu/native.py (the verify hot path never uses this variant).
+      acc = proj_add_complete(acc, e);
     }
   }
   return acc;
+}
+
+// Projective copies of the (public) per-base multiples for the ct schedule.
+template <typename F>
+static void msm_tables_proj(const std::vector<Jac<F>> &jtables, int k,
+                            std::vector<Proj<F>> &out) {
+  out.assign((size_t)k * 16, proj_inf<F>());
+  for (size_t i = 0; i < (size_t)k * 16; i++) {
+    F x, y;
+    bool inf;
+    jac_to_affine(jtables[i], x, y, inf);
+    if (!inf) out[i] = {x, y, FieldOps<F>::one()};
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -710,32 +861,9 @@ static inline void proj_add_step(ProjT &T, const Fp2 &qx, const Fp2 &qy,
   T = {X3, Y3, Z3};
 }
 
-// Accumulate one pair's Miller factor into f. P=(px,py) G1 affine,
-// Q=(qx,qy) twist affine; both non-infinite (caller filters).
-static void miller_accumulate(Fp12 &f, const Fp &px, const Fp &py,
-                              const Fp2 &qx, const Fp2 &qy) {
-  ProjT T = {qx, qy, FP2_ONE};
-  Fp2 lA, lB, lC;
-  // msb-first over |x| bits, skipping the leading 1
-  int top = 63;
-  while (!((BLS_X_ABS >> top) & 1)) top--;
-  Fp12 g = FP12_ONE;
-  for (int i = top - 1; i >= 0; i--) {
-    g = fp12_sq(g);
-    proj_double_step(T, lA, lB, lC);
-    g = fp12_mul_line(g, lA, fp2_mul_fp(lB, px), fp2_mul_fp(lC, py));
-    if ((BLS_X_ABS >> i) & 1) {
-      proj_add_step(T, qx, qy, lA, lB, lC);
-      g = fp12_mul_line(g, lA, fp2_mul_fp(lB, px), fp2_mul_fp(lC, py));
-    }
-  }
-  g = fp12_conj(g);  // x < 0
-  f = fp12_mul(f, g);
-}
-
-// NOTE: squaring the per-pair factor separately then multiplying loses the
-// shared-squaring optimization of a true multi-Miller loop; the batch API
-// below instead interleaves pairs inside ONE loop:
+// True multi-Miller loop: all pairs interleaved inside ONE loop so the
+// per-iteration fp12_sq is shared across pairs (squaring each pair's
+// factor separately and multiplying would lose that sharing).
 
 static Fp12 multi_miller(const Fp *pxs, const Fp *pys, const Fp2 *qxs,
                          const Fp2 *qys, const bool *skip, int n) {
@@ -791,6 +919,368 @@ static Fp12 final_exp(const Fp12 &f) {
                               fp12_frobenius2(t2)),
                      fp12_conj(t2));
   return fp12_mul(t3, fp12_mul(fp12_sq(m), m));
+}
+
+// ---------------------------------------------------------------------------
+// Hashing to fields and groups — native implementation of the framework's
+// CTH-v2 spec (coconut_tpu/ops/hashing.py): expand_message_xmd (SHA-256,
+// RFC 9380 §5.3.1 construction), hash_to_fr/fp, and the Shallue-van de
+// Woestijne map with import-time-derived constants. Replaces the last
+// amcl_wrapper `from_msg_hash` surface the C++ core was missing (reference
+// call sites signature.rs:23-29,205,598). Outputs are bit-identical to the
+// Python spec (tests/vectors/hashing.json, checked through this ABI).
+// ---------------------------------------------------------------------------
+
+// SHA-256 (FIPS 180-4), single-shot.
+namespace sha256 {
+static const uint32_t K[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+
+static inline uint32_t rotr(uint32_t x, int n) {
+  return (x >> n) | (x << (32 - n));
+}
+
+static void digest(const uint8_t *data, size_t len, uint8_t out[32]) {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  // padded message: len + 1 + pad + 8 length bytes, multiple of 64
+  size_t total = ((len + 8) / 64 + 1) * 64;
+  std::vector<uint8_t> buf(total, 0);
+  memcpy(buf.data(), data, len);
+  buf[len] = 0x80;
+  uint64_t bits = (uint64_t)len * 8;
+  for (int i = 0; i < 8; i++) buf[total - 1 - i] = (uint8_t)(bits >> (8 * i));
+  for (size_t off = 0; off < total; off += 64) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = ((uint32_t)buf[off + 4 * i] << 24) |
+             ((uint32_t)buf[off + 4 * i + 1] << 16) |
+             ((uint32_t)buf[off + 4 * i + 2] << 8) | buf[off + 4 * i + 3];
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+  for (int i = 0; i < 8; i++) {
+    out[4 * i] = (uint8_t)(h[i] >> 24);
+    out[4 * i + 1] = (uint8_t)(h[i] >> 16);
+    out[4 * i + 2] = (uint8_t)(h[i] >> 8);
+    out[4 * i + 3] = (uint8_t)h[i];
+  }
+}
+}  // namespace sha256
+
+// RFC 9380 §5.3.1 expand_message_xmd with SHA-256 (spec expand_message_xmd).
+static bool expand_xmd(const uint8_t *msg, size_t mlen, const uint8_t *dst,
+                       size_t dlen, size_t out_len, uint8_t *out) {
+  const size_t B_IN = 32, R_IN = 64;
+  if (dlen > 255) return false;
+  size_t ell = (out_len + B_IN - 1) / B_IN;
+  if (ell > 255) return false;
+  std::vector<uint8_t> buf;
+  buf.assign(R_IN, 0);  // z_pad
+  buf.insert(buf.end(), msg, msg + mlen);
+  buf.push_back((uint8_t)(out_len >> 8));
+  buf.push_back((uint8_t)out_len);
+  buf.push_back(0);
+  buf.insert(buf.end(), dst, dst + dlen);
+  buf.push_back((uint8_t)dlen);
+  uint8_t b0[32];
+  sha256::digest(buf.data(), buf.size(), b0);
+  std::vector<uint8_t> blk(32 + 1 + dlen + 1);
+  memcpy(blk.data(), b0, 32);
+  blk[32] = 1;
+  memcpy(blk.data() + 33, dst, dlen);
+  blk[33 + dlen] = (uint8_t)dlen;
+  uint8_t bi[32];
+  sha256::digest(blk.data(), blk.size(), bi);
+  size_t got = 0;
+  for (size_t i = 1;; i++) {
+    size_t take = out_len - got < 32 ? out_len - got : 32;
+    memcpy(out + got, bi, take);
+    got += take;
+    if (got == out_len) return true;
+    for (int j = 0; j < 32; j++) blk[j] = b0[j] ^ bi[j];
+    blk[32] = (uint8_t)(i + 1);
+    sha256::digest(blk.data(), blk.size(), bi);
+  }
+}
+
+// Big-endian byte string mod an nl-limb modulus (var-time; hashing is
+// public data). Horner over bytes with 8 shifted conditional subtractions.
+static void bytes_mod(const uint8_t *be, size_t len, const u64 *mod, int nl,
+                      u64 *out) {
+  std::vector<u64> acc(nl + 1, 0);
+  for (size_t i = 0; i < len; i++) {
+    // acc = acc * 256 + be[i]
+    u64 carry = be[i];
+    for (int j = 0; j < nl + 1; j++) {
+      u64 nv = (acc[j] << 8) | carry;
+      carry = acc[j] >> 56;
+      acc[j] = nv;
+    }
+    // reduce: acc < 256 * mod, subtract mod << s for s = 8..0
+    for (int s = 8; s >= 0; s--) {
+      // cmp acc ? mod << s (bit shift within the nl+1-limb window)
+      std::vector<u64> ms(nl + 1, 0);
+      for (int j = 0; j < nl; j++) {
+        ms[j] += (s < 64) ? (mod[j] << s) : 0;
+        if (s) ms[j + 1] |= mod[j] >> (64 - s);
+      }
+      // compare
+      int cmp = 0;
+      for (int j = nl; j >= 0; j--) {
+        if (acc[j] != ms[j]) {
+          cmp = acc[j] > ms[j] ? 1 : -1;
+          break;
+        }
+      }
+      if (cmp >= 0) {
+        u128 borrow = 0;
+        for (int j = 0; j < nl + 1; j++) {
+          u128 d = (u128)acc[j] - ms[j] - borrow;
+          acc[j] = (u64)d;
+          borrow = (d >> 64) & 1;
+        }
+      }
+    }
+  }
+  for (int j = 0; j < nl; j++) out[j] = acc[j];
+}
+
+static const u64 RL[4] = {0xffffffff00000001ULL, 0x53bda402fffe5bfeULL,
+                          0x3339d80809a1d805ULL, 0x73eda753299d7d48ULL};
+static const u64 G1_COF[2] = {0x8c00aaab0000aaabULL, 0x396c8c005555e156ULL};
+static const u64 G2_COF[8] = {
+    0xcf1c38e31c7238e5ULL, 0x1616ec6e786f0c70ULL, 0x21537e293a6691aeULL,
+    0xa628f1cb4d9e82efULL, 0xa68a205b2e5a7ddfULL, 0xcd91de4547085abaULL,
+    0x091d50792876a202ULL, 0x05d543a95414e7f1ULL};
+// sqrt/legendre exponents ((p+1)/4, (p-3)/4, (p-1)/2)
+static const u64 EXP_P14[6] = {0xee7fbfffffffeaabULL, 0x07aaffffac54ffffULL,
+                               0xd9cc34a83dac3d89ULL, 0xd91dd2e13ce144afULL,
+                               0x92c6e9ed90d2eb35ULL, 0x0680447a8e5ff9a6ULL};
+static const u64 EXP_P34[6] = {0xee7fbfffffffeaaaULL, 0x07aaffffac54ffffULL,
+                               0xd9cc34a83dac3d89ULL, 0xd91dd2e13ce144afULL,
+                               0x92c6e9ed90d2eb35ULL, 0x0680447a8e5ff9a6ULL};
+static const u64 EXP_P12[6] = {0xdcff7fffffffd555ULL, 0x0f55ffff58a9ffffULL,
+                               0xb39869507b587b12ULL, 0xb23ba5c279c2895fULL,
+                               0x258dd3db21a5d66bULL, 0x0d0088f51cbff34dULL};
+
+// canonical (out-of-Montgomery) raw limbs — for sgn0 and codecs
+static inline Fp fp_canonical(const Fp &a) {
+  Fp one = {{1, 0, 0, 0, 0, 0}};
+  return fp_mul(a, one);
+}
+
+static inline int fp_sgn0(const Fp &a) {
+  return (int)(fp_canonical(a).v[0] & 1);
+}
+
+static inline int fp2_sgn0(const Fp2 &a) {
+  Fp c0 = fp_canonical(a.c0);
+  int s0 = (int)(c0.v[0] & 1);
+  bool z0 = fp_is_zero_raw(c0);
+  int s1 = fp_sgn0(a.c1);
+  return s0 | ((int)z0 & s1);
+}
+
+// sqrt in Fp (p = 3 mod 4): a^((p+1)/4), verified (spec fp_sqrt)
+static bool fp_sqrt_(const Fp &a, Fp &out) {
+  Fp s = fp_pow(a, EXP_P14, 6);
+  if (!fp_eq_raw(fp_sq(s), a)) return false;
+  out = s;
+  return true;
+}
+
+// sqrt in Fp2, complex method (spec fp2_sqrt; same branch structure)
+static bool fp2_sqrt_(const Fp2 &a, Fp2 &out) {
+  if (fp2_is_zero(a)) {
+    out = FP2_ZERO;
+    return true;
+  }
+  Fp2 a1 = fp2_pow(a, EXP_P34, 6);
+  Fp2 x0 = fp2_mul(a1, a);
+  Fp2 alpha = fp2_mul(a1, x0);
+  Fp2 neg_one = {fp_neg(FP_ONE), FP_ZERO};
+  Fp2 x;
+  if (fp2_eq(alpha, neg_one)) {
+    Fp2 u = {FP_ZERO, FP_ONE};
+    x = fp2_mul(u, x0);
+  } else {
+    Fp2 b = fp2_pow(fp2_add(FP2_ONE, alpha), EXP_P12, 6);
+    x = fp2_mul(b, x0);
+  }
+  if (!fp2_eq(fp2_sq(x), a)) return false;
+  out = x;
+  return true;
+}
+
+// Field adapter for the generic SvdW map (mirrors spec _FpAdapter/_Fp2Adapter)
+struct SvdWFp {
+  using T = Fp;
+  static Fp embed(long k) {
+    Fp r = fp_mul_small(FP_ONE, (u64)(k < 0 ? -k : k));
+    return k < 0 ? fp_neg(r) : r;
+  }
+  static Fp b() { return embed(4); }
+  static Fp add(const Fp &a, const Fp &b_) { return fp_add(a, b_); }
+  static Fp sub(const Fp &a, const Fp &b_) { return fp_sub(a, b_); }
+  static Fp mul(const Fp &a, const Fp &b_) { return fp_mul(a, b_); }
+  static Fp sq(const Fp &a) { return fp_sq(a); }
+  static Fp neg(const Fp &a) { return fp_neg(a); }
+  static Fp inv0(const Fp &a) {
+    return fp_is_zero_raw(a) ? FP_ZERO : fp_inv(a);
+  }
+  static bool sqrt(const Fp &a, Fp &o) { return fp_sqrt_(a, o); }
+  static int sgn0(const Fp &a) { return fp_sgn0(a); }
+  static bool is_zero(const Fp &a) { return fp_is_zero_raw(a); }
+};
+
+struct SvdWFp2 {
+  using T = Fp2;
+  static Fp2 embed(long k) { return {SvdWFp::embed(k), FP_ZERO}; }
+  static Fp2 b() { return {SvdWFp::embed(4), SvdWFp::embed(4)}; }
+  static Fp2 add(const Fp2 &a, const Fp2 &b_) { return fp2_add(a, b_); }
+  static Fp2 sub(const Fp2 &a, const Fp2 &b_) { return fp2_sub(a, b_); }
+  static Fp2 mul(const Fp2 &a, const Fp2 &b_) { return fp2_mul(a, b_); }
+  static Fp2 sq(const Fp2 &a) { return fp2_sq(a); }
+  static Fp2 neg(const Fp2 &a) { return fp2_neg(a); }
+  static Fp2 inv0(const Fp2 &a) {
+    return fp2_is_zero(a) ? FP2_ZERO : fp2_inv(a);
+  }
+  static bool sqrt(const Fp2 &a, Fp2 &o) { return fp2_sqrt_(a, o); }
+  static int sgn0(const Fp2 &a) { return fp2_sgn0(a); }
+  static bool is_zero(const Fp2 &a) { return fp2_is_zero(a); }
+};
+
+template <typename A>
+struct SvdWConsts {
+  typename A::T Z, c1, c2, c3, c4;
+};
+
+// Derive the SvdW constants exactly as the spec does (hashing.py
+// _svdw_constants): first Z in (1, -1, 2, -2, ...) passing the RFC 9380
+// §6.6.1 criteria; c3 sign-normalized to sgn0 == 0.
+template <typename A>
+static SvdWConsts<A> svdw_derive() {
+  using T = typename A::T;
+  auto g = [](const T &x) { return A::add(A::mul(A::sq(x), x), A::b()); };
+  auto is_sq = [](const T &a) {
+    T tmp;
+    return A::sqrt(a, tmp);
+  };
+  T half = A::inv0(A::embed(2));
+  for (long k = 1; k <= 64; k++) {
+    for (int sign = 0; sign < 2; sign++) {
+      T Z = A::embed(sign ? -k : k);
+      T gZ = g(Z);
+      if (A::is_zero(gZ)) continue;
+      T h = A::mul(A::embed(3), A::sq(Z));
+      if (A::is_zero(h)) continue;
+      T t = A::neg(A::mul(h, A::inv0(A::mul(A::embed(4), gZ))));
+      if (A::is_zero(t) || !is_sq(t)) continue;
+      if (!(is_sq(gZ) || is_sq(g(A::mul(A::neg(Z), half))))) continue;
+      SvdWConsts<A> c;
+      c.Z = Z;
+      c.c1 = gZ;
+      c.c2 = A::mul(A::neg(Z), half);
+      A::sqrt(A::neg(A::mul(gZ, h)), c.c3);
+      if (A::sgn0(c.c3) == 1) c.c3 = A::neg(c.c3);
+      c.c4 = A::mul(A::neg(A::mul(A::embed(4), gZ)), A::inv0(h));
+      return c;
+    }
+  }
+  // unreachable for BLS12-381 (the spec asserts the same)
+  SvdWConsts<A> c{};
+  return c;
+}
+
+static SvdWConsts<SvdWFp> SVDW_FP;
+static SvdWConsts<SvdWFp2> SVDW_FP2;
+static bool svdw_ready = false;
+
+static void svdw_init() {
+  if (svdw_ready) return;
+  SVDW_FP = svdw_derive<SvdWFp>();
+  SVDW_FP2 = svdw_derive<SvdWFp2>();
+  // flag only AFTER derivation: a concurrent caller must never observe
+  // svdw_ready with zeroed constants (the derive runs long enough that the
+  // race window is real under GIL-released ctypes calls)
+  svdw_ready = true;
+}
+
+// RFC 9380 §6.6.1 straight-line SvdW map (spec _map_to_curve_svdw)
+template <typename A>
+static void map_svdw(const SvdWConsts<A> &C, const typename A::T &u,
+                     typename A::T &ox, typename A::T &oy) {
+  using T = typename A::T;
+  T one = A::embed(1);
+  T tv1 = A::mul(A::sq(u), C.c1);
+  T tv2 = A::add(one, tv1);
+  tv1 = A::sub(one, tv1);
+  T tv3 = A::inv0(A::mul(tv1, tv2));
+  T tv4 = A::mul(A::mul(A::mul(u, tv1), tv3), C.c3);
+  T x1 = A::sub(C.c2, tv4);
+  T x2 = A::add(C.c2, tv4);
+  T x3 = A::add(A::mul(A::sq(A::mul(A::sq(tv2), tv3)), C.c4), C.Z);
+  auto g = [](const T &x) { return A::add(A::mul(A::sq(x), x), A::b()); };
+  T x, y;
+  if (A::sqrt(g(x1), y)) {
+    x = x1;
+  } else if (A::sqrt(g(x2), y)) {
+    x = x2;
+  } else {
+    x = x3;
+    A::sqrt(g(x3), y);
+  }
+  if (A::sgn0(y) != A::sgn0(u)) y = A::neg(y);
+  ox = x;
+  oy = y;
+}
+
+// var-time scalar mult by a multi-limb scalar (cofactor clearing)
+template <typename F>
+static Jac<F> jac_mul_limbs(const Jac<F> &p, const u64 *e, int nl) {
+  Jac<F> acc = jac_inf<F>();
+  bool started = false;
+  for (int i = nl - 1; i >= 0; i--)
+    for (int bit = 63; bit >= 0; bit--) {
+      if (started) acc = jac_double(acc);
+      if ((e[i] >> bit) & 1) {
+        if (!started) {
+          acc = p;
+          started = true;
+        } else {
+          acc = jac_add(acc, p);
+        }
+      }
+    }
+  return acc;
 }
 
 // ---------------------------------------------------------------------------
@@ -918,15 +1408,21 @@ void cc_msm_g1(const uint8_t *bases, const uint8_t *scalars, int k, int B,
   }
   std::vector<Jac<Fp>> tables;
   msm_tables<Fp>(bx.data(), by.data(), (const bool *)binf.data(), k, tables);
+  std::vector<Proj<Fp>> ptables;
+  if (ct) msm_tables_proj(tables, k, ptables);
   std::vector<Scalar> srow(k);
   for (int i = 0; i < B; i++) {
     for (int j = 0; j < k; j++)
       srow[j] = scalar_load(scalars + ((size_t)i * k + j) * 32);
-    Jac<Fp> acc = ct ? msm_row_ct<Fp>(tables, srow.data(), k)
-                     : msm_row<Fp>(tables, srow.data(), k);
     Fp x, y;
     bool inf;
-    jac_to_affine(acc, x, y, inf);
+    if (ct) {
+      Proj<Fp> acc = msm_row_ct<Fp>(ptables, srow.data(), k);
+      proj_to_affine(acc, x, y, inf);
+    } else {
+      Jac<Fp> acc = msm_row<Fp>(tables, srow.data(), k);
+      jac_to_affine(acc, x, y, inf);
+    }
     g1_store(out + (size_t)i * 96, x, y, inf);
   }
 }
@@ -941,15 +1437,21 @@ void cc_msm_g2(const uint8_t *bases, const uint8_t *scalars, int k, int B,
   }
   std::vector<Jac<Fp2>> tables;
   msm_tables<Fp2>(bx.data(), by.data(), (const bool *)binf.data(), k, tables);
+  std::vector<Proj<Fp2>> ptables;
+  if (ct) msm_tables_proj(tables, k, ptables);
   std::vector<Scalar> srow(k);
   for (int i = 0; i < B; i++) {
     for (int j = 0; j < k; j++)
       srow[j] = scalar_load(scalars + ((size_t)i * k + j) * 32);
-    Jac<Fp2> acc = ct ? msm_row_ct<Fp2>(tables, srow.data(), k)
-                      : msm_row<Fp2>(tables, srow.data(), k);
     Fp2 x, y;
     bool inf;
-    jac_to_affine(acc, x, y, inf);
+    if (ct) {
+      Proj<Fp2> acc = msm_row_ct<Fp2>(ptables, srow.data(), k);
+      proj_to_affine(acc, x, y, inf);
+    } else {
+      Jac<Fp2> acc = msm_row<Fp2>(tables, srow.data(), k);
+      jac_to_affine(acc, x, y, inf);
+    }
     g2_store(out + (size_t)i * 192, x, y, inf);
   }
 }
@@ -993,7 +1495,6 @@ void cc_g1_mul(const uint8_t *pts, const uint8_t *scalars, int B,
         for (int d = 0; d < 4; d++) acc = jac_double(acc);
       unsigned dg = scalar_window(s, w);
       if (dg) {
-        Jac<Fp> base = {x, y, FP_ONE};
         Jac<Fp> t = jac_inf<Fp>();
         for (unsigned b = 0; b < dg; b++) t = jac_add_affine(t, x, y, false);
         acc = jac_add(acc, t);
@@ -1004,6 +1505,122 @@ void cc_g1_mul(const uint8_t *pts, const uint8_t *scalars, int B,
     jac_to_affine(acc, ox, oy, oinf);
     g1_store(out + (size_t)i * 96, ox, oy, oinf);
   }
+}
+
+// ONE Pippenger bucket MSM over n distinct G1 points (var-time, public
+// data — reference multi_scalar_mul_var_time, signature.rs:513,521).
+// pts: n*96B affine; scalars: n*32B; out: 96B affine.
+void cc_msm_pippenger_g1(const uint8_t *pts, const uint8_t *scalars, int n,
+                         uint8_t *out) {
+  ccbls_init();
+  std::vector<Fp> xs(n), ys(n);
+  std::vector<char> inf(n);
+  std::vector<Scalar> s(n);
+  for (int i = 0; i < n; i++) {
+    inf[i] = g1_load(pts + (size_t)i * 96, xs[i], ys[i]);
+    s[i] = scalar_load(scalars + (size_t)i * 32);
+  }
+  Jac<Fp> acc = msm_pippenger<Fp>(xs.data(), ys.data(),
+                                  (const bool *)inf.data(), s.data(), n);
+  Fp x, y;
+  bool oinf;
+  jac_to_affine(acc, x, y, oinf);
+  g1_store(out, x, y, oinf);
+}
+
+void cc_msm_pippenger_g2(const uint8_t *pts, const uint8_t *scalars, int n,
+                         uint8_t *out) {
+  ccbls_init();
+  std::vector<Fp2> xs(n), ys(n);
+  std::vector<char> inf(n);
+  std::vector<Scalar> s(n);
+  for (int i = 0; i < n; i++) {
+    inf[i] = g2_load(pts + (size_t)i * 192, xs[i], ys[i]);
+    s[i] = scalar_load(scalars + (size_t)i * 32);
+  }
+  Jac<Fp2> acc = msm_pippenger<Fp2>(xs.data(), ys.data(),
+                                    (const bool *)inf.data(), s.data(), n);
+  Fp2 x, y;
+  bool oinf;
+  jac_to_affine(acc, x, y, oinf);
+  g2_store(out, x, y, oinf);
+}
+
+// hash_to_fr (spec hash_to_fr): 64 xmd bytes reduced mod r -> 32B LE out.
+// Returns 0 on success, nonzero on bad DST length.
+int cc_hash_to_fr(const uint8_t *msg, int mlen, const uint8_t *dst, int dlen,
+                  uint8_t *out32) {
+  ccbls_init();
+  uint8_t u[64];
+  if (!expand_xmd(msg, (size_t)mlen, dst, (size_t)dlen, 64, u)) return 1;
+  u64 limbs[4];
+  bytes_mod(u, 64, RL, 4, limbs);
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < 8; j++)
+      out32[i * 8 + j] = (uint8_t)(limbs[i] >> (8 * j));
+  return 0;
+}
+
+// hash_to_g1 (spec hash_to_g1): clear_cofactor(svdw(u0) + svdw(u1)),
+// out = 96B affine (all-zero = identity, probability ~2^-255). Returns 0
+// on success.
+int cc_hash_to_g1(const uint8_t *msg, int mlen, const uint8_t *dst, int dlen,
+                  uint8_t *out96) {
+  ccbls_init();
+  svdw_init();
+  uint8_t u[128];
+  if (!expand_xmd(msg, (size_t)mlen, dst, (size_t)dlen, 128, u)) return 1;
+  Fp pts[2][2];
+  for (int h = 0; h < 2; h++) {
+    u64 limbs[6];
+    bytes_mod(u + 64 * h, 64, PL, 6, limbs);
+    uint8_t le[48];
+    for (int i = 0; i < 6; i++)
+      for (int j = 0; j < 8; j++)
+        le[i * 8 + j] = (uint8_t)(limbs[i] >> (8 * j));
+    Fp uf = fp_from_le(le);
+    map_svdw<SvdWFp>(SVDW_FP, uf, pts[h][0], pts[h][1]);
+  }
+  Jac<Fp> q = jac_add<Fp>({pts[0][0], pts[0][1], FP_ONE},
+                          {pts[1][0], pts[1][1], FP_ONE});
+  Jac<Fp> r = jac_mul_limbs(q, G1_COF, 2);
+  Fp x, y;
+  bool inf;
+  jac_to_affine(r, x, y, inf);
+  g1_store(out96, x, y, inf);
+  return 0;
+}
+
+// hash_to_g2 (spec hash_to_g2): out = 192B affine twist point.
+int cc_hash_to_g2(const uint8_t *msg, int mlen, const uint8_t *dst, int dlen,
+                  uint8_t *out192) {
+  ccbls_init();
+  svdw_init();
+  uint8_t u[256];
+  if (!expand_xmd(msg, (size_t)mlen, dst, (size_t)dlen, 256, u)) return 1;
+  Fp2 pts[2][2];
+  for (int h = 0; h < 2; h++) {
+    Fp comp[2];
+    for (int c = 0; c < 2; c++) {
+      u64 limbs[6];
+      bytes_mod(u + 128 * h + 64 * c, 64, PL, 6, limbs);
+      uint8_t le[48];
+      for (int i = 0; i < 6; i++)
+        for (int j = 0; j < 8; j++)
+          le[i * 8 + j] = (uint8_t)(limbs[i] >> (8 * j));
+      comp[c] = fp_from_le(le);
+    }
+    Fp2 uf = {comp[0], comp[1]};
+    map_svdw<SvdWFp2>(SVDW_FP2, uf, pts[h][0], pts[h][1]);
+  }
+  Jac<Fp2> q = jac_add<Fp2>({pts[0][0], pts[0][1], FP2_ONE},
+                            {pts[1][0], pts[1][1], FP2_ONE});
+  Jac<Fp2> r = jac_mul_limbs(q, G2_COF, 8);
+  Fp2 x, y;
+  bool inf;
+  jac_to_affine(r, x, y, inf);
+  g2_store(out192, x, y, inf);
+  return 0;
 }
 
 int cc_selftest() {
@@ -1033,3 +1650,64 @@ int cc_selftest() {
 }
 
 }  // extern "C"
+
+#ifdef CCBLS_SELFTEST_MAIN
+// Standalone sanitizer-run entry (make -C native selftest_asan; ci.sh):
+// exercises the arithmetic selftest plus the allocation-heavy ABI paths
+// (MSM tables, multi-Miller, hashing) under ASan+UBSan.
+#include <cstdio>
+
+int main() {
+  int rc = cc_selftest();
+  if (rc) {
+    fprintf(stderr, "cc_selftest failed: %d\n", rc);
+    return rc;
+  }
+  // hash-to-group + MSM + pairing round trip on derived points
+  uint8_t g1b[96], g2b[192], frb[32];
+  const uint8_t dst1[] = "COCONUT-TPU-V2-G1";
+  const uint8_t dst2[] = "COCONUT-TPU-V2-G2";
+  const uint8_t dstr[] = "COCONUT-TPU-V2-FR";
+  const uint8_t msg[] = "ci-selftest";
+  if (cc_hash_to_g1(msg, 11, dst1, 17, g1b)) return 10;
+  if (cc_hash_to_g2(msg, 11, dst2, 17, g2b)) return 11;
+  if (cc_hash_to_fr(msg, 11, dstr, 17, frb)) return 12;
+  // e(a P, Q) * e(-P, a Q) == 1  via cc_msm + cc_pairing_product_is_one
+  uint8_t scal[64] = {0};
+  memcpy(scal, frb, 32);        // a
+  memcpy(scal + 32, frb, 32);   // a (same scalar for the G2 side)
+  uint8_t ap[96], aq[192];
+  cc_msm_g1(g1b, scal, 1, 1, ap, 0);
+  cc_msm_g2(g2b, scal + 32, 1, 1, aq, 0);
+  // -P: negate y of the affine G1 point = p - y
+  uint8_t negp[96];
+  memcpy(negp, g1b, 96);
+  {
+    // y' = p - y (big-int subtract on 48B LE)
+    static const uint8_t ple[48] = {
+        0xab, 0xaa, 0xff, 0xff, 0xff, 0xff, 0xfe, 0xb9, 0xff, 0xff, 0x53,
+        0xb1, 0xfe, 0xff, 0xab, 0x1e, 0x24, 0xf6, 0xb0, 0xf6, 0xa0, 0xd2,
+        0x30, 0x67, 0xbf, 0x12, 0x85, 0xf3, 0x84, 0x4b, 0x77, 0x64, 0xd7,
+        0xac, 0x4b, 0x43, 0xb6, 0xa7, 0x1b, 0x4b, 0x9a, 0xe6, 0x7f, 0x39,
+        0xea, 0x11, 0x01, 0x1a};
+    int borrow = 0;
+    for (int i = 0; i < 48; i++) {
+      int d = (int)ple[i] - (int)negp[48 + i] - borrow;
+      borrow = d < 0;
+      negp[48 + i] = (uint8_t)(d + (borrow << 8));
+    }
+  }
+  uint8_t ps[192], qs[384], ok = 0;
+  memcpy(ps, ap, 96);
+  memcpy(ps + 96, negp, 96);
+  memcpy(qs, g2b, 192);
+  memcpy(qs + 192, aq, 192);
+  cc_pairing_product_is_one(ps, qs, 2, 1, &ok);
+  if (!ok) {
+    fprintf(stderr, "pairing bilinearity check failed\n");
+    return 13;
+  }
+  printf("ccbls sanitizer selftest: ok\n");
+  return 0;
+}
+#endif

