@@ -109,6 +109,13 @@ def batch_show_verify(
             return backend.batch_show_verify(
                 proofs, vk, params, revealed_msgs_list, challenges
             )
+    if backend is not None and not uniform:
+        # a real-workload cliff worth surfacing: the fused kernel needs one
+        # shared revealed-index set, so ragged batches run sequentially
+        from . import metrics
+
+        metrics.count("show_verify_ragged_fallback")
+        metrics.count("show_verify_ragged_proofs", len(proofs))
     return [
         p.verify(vk, params, rm, c)
         for p, rm, c in zip(proofs, revealed_msgs_list, challenges)

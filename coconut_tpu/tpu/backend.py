@@ -42,8 +42,22 @@ from . import tower as tw
 _R_RAND_BITS = 128
 _R_NWIN = -(-_R_RAND_BITS // 5) + 1  # 27
 
+# The grouped verify's window schedule: signed 6-bit (43 windows, 33-entry
+# on-device tables) — the fold adds dominate there and drop ~17% vs the
+# 5-bit schedule; the comb/distinct paths keep 5-bit (17-entry host tables).
+_G_WINDOW = 6
+_G_NWIN = -(-255 // _G_WINDOW)  # 43
+_G_RNWIN = -(-_R_RAND_BITS // _G_WINDOW) + 1  # 23
+
 
 _SIGNED_NWIN = 52  # signed 5-bit windows covering the 255-bit Fr
+
+# Comb (shared-base) schedule: signed 6-bit — the comb has NO doublings, so
+# fewer windows = strictly fewer fold adds (301 vs 364 at k=7); the larger
+# host tables (33 multiples/base) amortize behind the per-verkey cache.
+_C_WINDOW = 6
+_C_NWIN = -(-255 // _C_WINDOW)  # 43
+_C_ENTRIES = (1 << (_C_WINDOW - 1)) + 1  # 33
 
 
 def _build_tables(spec_ops, bases, entries=16):
@@ -70,9 +84,9 @@ def _build_tables(spec_ops, bases, entries=16):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
-def _comb_build_kernel(field_is_fp2, tables17):
+def _comb_build_kernel(field_is_fp2, tables_e):
     fl = cv.FP2 if field_is_fp2 else cv.FP
-    return cv.build_comb_tables(fl, tables17, _SIGNED_NWIN)
+    return cv.build_comb_tables(fl, tables_e, _C_NWIN, _C_WINDOW)
 
 
 # (is_fp2, base points) -> device comb tables. Bases are spec tuples of
@@ -83,32 +97,36 @@ def _comb_build_kernel(field_is_fp2, tables17):
 _COMB_CACHE = {}
 
 
-def _comb_tables(spec_ops, is_fp2, bases, cache=True):
+def _comb_tables(spec_ops, is_fp2, bases):
     key = (is_fp2, tuple(bases))
     wt = _COMB_CACHE.get(key)
     if wt is None:
-        t17 = _build_tables(spec_ops, bases, entries=17)
-        wt = _comb_build_kernel(is_fp2, t17)
-        if cache:
-            if len(_COMB_CACHE) > 64:  # ad-hoc base sets must not pile up
-                _COMB_CACHE.clear()
-            _COMB_CACHE[key] = wt
+        t_e = _build_tables(spec_ops, bases, entries=_C_ENTRIES)
+        wt = _comb_build_kernel(is_fp2, t_e)
+        if len(_COMB_CACHE) > 64:  # ad-hoc base sets must not pile up
+            _COMB_CACHE.clear()
+        _COMB_CACHE[key] = wt
     return wt
 
 
-def _signed_digits(scalars_batch):
-    """[B][k] ints -> (mag uint8, sgn bool) [B, k, 52] signed 5-bit window
-    digits (msb first), the comb/signed-Horner MSM schedule."""
+def _signed_digits(scalars_batch, nwin=_SIGNED_NWIN, window=5):
+    """[B][k] ints -> (mag uint8, sgn bool) [B, k, nwin] signed window
+    digits (msb first). Default 5-bit/52 is the distinct-MSM Horner
+    schedule; the comb paths pass the 6-bit/43 schedule."""
     from .limbs import fr_digits_signed_np
 
     B = len(scalars_batch)
     k = len(scalars_batch[0]) if B else 0
     flat = [s for row in scalars_batch for s in row]
-    mag, sgn = fr_digits_signed_np(flat)
+    mag, sgn = fr_digits_signed_np(flat, nwin=nwin, window=window)
     return (
-        jnp.asarray(mag.reshape(B, k, _SIGNED_NWIN)),
-        jnp.asarray(sgn.reshape(B, k, _SIGNED_NWIN)),
+        jnp.asarray(mag.reshape(B, k, nwin)),
+        jnp.asarray(sgn.reshape(B, k, nwin)),
     )
+
+
+def _comb_digits(scalars_batch):
+    return _signed_digits(scalars_batch, nwin=_C_NWIN, window=_C_WINDOW)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -183,7 +201,7 @@ def fused_verify(sig_is_g1, wtables, mag, sgn, s1, s2n, gtx, gty, inf1, inf2):
 
     sig_is_g1: signatures live in G1 (ctx "G1") — accumulator is in G2;
     otherwise roles flip. wtables: per-verkey comb window tables
-    (cv.build_comb_tables); mag/sgn: signed 5-bit digits [B, k, 52];
+    (cv.build_comb_tables); mag/sgn: signed 6-bit digits [B, k, 43];
     s1/s2n: sigma_1 and -sigma_2 coordinate pytrees [B]; gtx/gty: g_tilde
     affine coordinates pre-encoded as limb pytrees; inf1/inf2: identity
     masks for sigma_1 / sigma_2."""
@@ -292,26 +310,28 @@ _fused_verify_combined_kernel = functools.partial(
 
 
 def _grouped_msms(fl, x, y, inf, mag, sgn):
-    """M MSMs over the SAME [B] points: signed 5-bit window digits
-    mag/sgn [M, B, nwin] (msb first, digit = (-1)^sgn * mag, mag <= 16)
+    """M MSMs over the SAME [B] points: signed 6-bit window digits
+    mag/sgn [M, B, nwin] (msb first, digit = (-1)^sgn * mag, mag <= 32)
     -> projective accumulators [M].
 
     Structure (this is the whole per-credential cost of the grouped verify
     — no OtherGroup arithmetic, no per-credential pairing):
-      1. one on-device 17-entry table build (16 batched adds over [B]);
+      1. one on-device 33-entry table build (32 batched adds over [B]);
       2. ONE gather of all (msm, window, point) table entries [M, nwin, B]
          — the window axis rides in the lane dimension, so the fold runs
          at full width instead of once per window — with the sign applied
          as a Y-flip (free elementwise negate + lane select);
       3. fold over the B axis: ~B-1 lane-adds per (m, w) via fold_points;
-      4. a Horner scan over the nwin window sums: 5 doublings + 1 add on
+      4. a Horner scan over the nwin window sums: 6 doublings + 1 add on
          [M] lanes per window."""
-    tables = cv.build_tables_device(fl, x, y, inf, entries=17)
+    tables = cv.build_tables_device(
+        fl, x, y, inf, entries=(1 << (_G_WINDOW - 1)) + 1
+    )
     M, B, nwin = mag.shape
     dw = jnp.moveaxis(mag, 1, 2)  # [M, nwin, B]
     sw = jnp.moveaxis(sgn, 1, 2)
 
-    def leaf(t):  # t: [B, 17, L...] -> [M, nwin, B, L...]
+    def leaf(t):  # t: [B, 33, L...] -> [M, nwin, B, L...]
         tb = jnp.broadcast_to(t[None, None], (M, nwin) + t.shape)
         ix = dw[..., None].reshape(dw.shape + (1,) * (t.ndim - 1))
         return jnp.take_along_axis(tb, ix, axis=3)[:, :, :, 0]
@@ -322,7 +342,9 @@ def _grouped_msms(fl, x, y, inf, mag, sgn):
     Sw = jax.tree_util.tree_map(lambda t: jnp.moveaxis(t, 1, 0), S)
 
     def body(acc, s):
-        acc = jax.lax.fori_loop(0, 5, lambda _, a: cv.jdouble(fl, a), acc)
+        acc = jax.lax.fori_loop(
+            0, _G_WINDOW, lambda _, a: cv.jdouble(fl, a), acc
+        )
         return cv.jadd(fl, acc, s), None
 
     acc, _ = jax.lax.scan(body, cv.jinfinity(fl, (M,)), Sw)
@@ -402,9 +424,9 @@ def fused_verify_grouped(
     batch (_grouped_msms). Soundness 2^-128 per forged credential, as in
     fused_verify_combined.
 
-    Shapes: s1/s2n coordinate pytrees [B]; cmag/csgn [q+1, B, 52] signed
-    5-bit window digits (scalars r_i then r_i*m_ij mod r); rmag/rsgn
-    [1, B, 27] (r_i for the -s2 sum — r_i are 128-bit so only the low 27
+    Shapes: s1/s2n coordinate pytrees [B]; cmag/csgn [q+1, B, 43] signed
+    6-bit window digits (scalars r_i then r_i*m_ij mod r); rmag/rsgn
+    [1, B, 23] (r_i for the -s2 sum — r_i are 128-bit so only the low 23
     msb-first windows can be nonzero); ox/oy [q+1] other-group affine (X
     then Y_j); gtx/gty other-group affine g. B power of two."""
     sig_fl = cv.FP if sig_is_g1 else cv.FP2
@@ -519,8 +541,11 @@ class JaxBackend(CurveBackend):
     # -- CurveBackend primitives --------------------------------------------
 
     def _msm_shared(self, spec_ops, is_fp2, bases, scalars_batch):
-        wtables = _comb_tables(spec_ops, is_fp2, bases, cache=False)
-        mag, sgn = _signed_digits(scalars_batch)
+        # cached: the hot users (batch_show / batch_prepare_blind_sign /
+        # issuance) call with FIXED base sets (verkey components, params
+        # generators) — the 64-entry cap in _comb_tables guards ad-hoc sets
+        wtables = _comb_tables(spec_ops, is_fp2, bases)
+        mag, sgn = _comb_digits(scalars_batch)
         x, y, inf = _msm_affine_kernel(is_fp2, wtables, mag, sgn)
         xs = tw.decode_batch(x)
         ys = tw.decode_batch(y)
@@ -596,7 +621,7 @@ class JaxBackend(CurveBackend):
             bases = bases + [None] * npad
             scalars = [row + [0] * npad for row in scalars]
         wtables = _comb_tables(ctx.other, ctx.name == "G1", bases)
-        mag, sgn = _signed_digits(scalars)
+        mag, sgn = _comb_digits(scalars)
 
         sig_pts_1 = [s.sigma_1 for s in sigs]
         sig_pts_2n = [
@@ -641,8 +666,11 @@ class JaxBackend(CurveBackend):
 
     def batch_verify_grouped_async(self, sigs, messages_list, vk, params):
         """Pipelined variant of `batch_verify_grouped` (ONE bool per batch):
-        dispatches the grouped kernel and returns a zero-arg finalizer."""
+        dispatches the grouped kernel and returns a zero-arg finalizer.
+        Same input validation as the sync path (mismatched batches must
+        raise, not truncate)."""
         B = len(sigs)
+        self._validate_grouped_inputs(sigs, messages_list, vk)
         if B == 0:
             return lambda: True
         if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
@@ -650,6 +678,22 @@ class JaxBackend(CurveBackend):
         operands = self.encode_grouped_batch(sigs, messages_list, vk, params)
         ok = _fused_verify_grouped_kernel(params.ctx.name == "G1", *operands)
         return lambda: bool(ok)
+
+    @staticmethod
+    def _validate_grouped_inputs(sigs, messages_list, vk):
+        B = len(sigs)
+        q = len(vk.Y_tilde)
+        if len(messages_list) != B:
+            raise ValueError(
+                "batch size mismatch: %d sigs, %d message vectors"
+                % (B, len(messages_list))
+            )
+        for msgs in messages_list:
+            if len(msgs) != q:
+                raise ValueError(
+                    "message vector length %d != msg_count %d"
+                    % (len(msgs), q)
+                )
 
     def batch_verify(self, sigs, messages_list, vk, params):
         """Fully-fused batched PS verification (the north-star path)."""
@@ -737,7 +781,7 @@ class JaxBackend(CurveBackend):
         # Schnorr operands
         vc_bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
         vc_wtables = _comb_tables(oth, is_g1_ctx, vc_bases)
-        resp_mag, resp_sgn = _signed_digits(
+        resp_mag, resp_sgn = _comb_digits(
             [[r % R for r in p.proof_vc.responses] for p in proofs]
         )
         enc_other = (
@@ -750,7 +794,7 @@ class JaxBackend(CurveBackend):
         # pairing operands
         acc_bases = [vk.X_tilde] + [vk.Y_tilde[i] for i in revealed]
         acc_wtables = _comb_tables(oth, is_g1_ctx, acc_bases)
-        acc_mag, acc_sgn = _signed_digits(
+        acc_mag, acc_sgn = _comb_digits(
             [
                 [1] + [rm[i] % R for i in revealed]
                 for rm in revealed_msgs_list
@@ -797,18 +841,7 @@ class JaxBackend(CurveBackend):
         import secrets
 
         B = len(sigs)
-        q = len(vk.Y_tilde)
-        if len(messages_list) != B:
-            raise ValueError(
-                "batch size mismatch: %d sigs, %d message vectors"
-                % (B, len(messages_list))
-            )
-        for msgs in messages_list:
-            if len(msgs) != q:
-                raise ValueError(
-                    "message vector length %d != msg_count %d"
-                    % (len(msgs), q)
-                )
+        self._validate_grouped_inputs(sigs, messages_list, vk)
         if B == 0:
             return True
         if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
@@ -823,7 +856,8 @@ class JaxBackend(CurveBackend):
         """Host-side encoding for the grouped verify kernel: pads the batch
         to a power of two (>= pad_batch_to if given — the sharded path needs
         the batch divisible by the mesh's dp extent), samples the combiner
-        scalars, and recodes all scalar rows to signed 5-bit windows.
+        scalars, and recodes all scalar rows to the signed 6-bit/43-window
+        schedule (_G_WINDOW/_G_NWIN).
         Returns the fused_verify_grouped operand tuple (everything after
         sig_is_g1). Callers must have rejected empty batches and identity
         sigmas already."""
@@ -847,21 +881,24 @@ class JaxBackend(CurveBackend):
         ]
         from .limbs import fr_digits_signed_np
 
-        recoded = [fr_digits_signed_np(row) for row in rows]
+        recoded = [
+            fr_digits_signed_np(row, nwin=_G_NWIN, window=_G_WINDOW)
+            for row in rows
+        ]
         cmag = jnp.asarray(np.stack([m for m, _ in recoded]))
-        csgn = jnp.asarray(np.stack([s for _, s in recoded]))  # [q+1, Bp, 52]
-        # r_i are _R_RAND_BITS-bit: only the last _R_NWIN msb-first windows
+        csgn = jnp.asarray(np.stack([s for _, s in recoded]))  # [q+1, Bp, 43]
+        # r_i are _R_RAND_BITS-bit: only the last _G_RNWIN msb-first windows
         # of the r-row can be nonzero — slice so the -sigma_2 MSM runs a
         # short schedule. A real check (not assert: must survive python -O)
         # so a widened sampler can never silently drop top windows.
         nwin = cmag.shape[-1]
-        if recoded[0][0][:, : nwin - _R_NWIN].any():
+        if recoded[0][0][:, : nwin - _G_RNWIN].any():
             raise ValueError(
                 "combiner scalar exceeds %d bits: top windows nonzero"
                 % _R_RAND_BITS
             )
-        rmag = cmag[:1, :, nwin - _R_NWIN :]
-        rsgn = csgn[:1, :, nwin - _R_NWIN :]
+        rmag = cmag[:1, :, nwin - _G_RNWIN :]
+        rsgn = csgn[:1, :, nwin - _G_RNWIN :]
 
         s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
             ctx,

@@ -240,26 +240,26 @@ def fold_points_any(fl, pts, n, axis_offset=0):
     return acc
 
 
-def build_comb_tables(fl, tables17, nwin):
+def build_comb_tables(fl, tables_e, nwin, window=5):
     """Fixed-base comb window tables for the shared-base MSM.
 
-    tables17: projective multiples 0..16 as a pytree with leading [k, 17]
-    (entry 0 = identity). Returns leading [k, nwin, 17] where entry
-    (j, w, d) = d * 32^(nwin-1-w) * base_j — i.e. the w-th MS-first signed
-    5-bit window digit's contribution is a pure table lookup, so the MSM
-    itself needs NO doublings. The scaling scan runs on the tiny [k, 17]
-    shape (5 doublings per window), so table build cost is negligible
-    against the [B]-wide MSM; per-verkey tables are cached device-side by
-    the backend."""
+    tables_e: projective multiples 0..2^(window-1) as a pytree with leading
+    [k, 2^(window-1)+1] (entry 0 = identity). Returns leading
+    [k, nwin, entries] where entry (j, w, d) = d * (2^window)^(nwin-1-w) *
+    base_j — i.e. the w-th MS-first signed window digit's contribution is a
+    pure table lookup, so the MSM itself needs NO doublings. The scaling
+    scan runs on the tiny [k, entries] shape (`window` doublings per
+    window), so table build cost is negligible against the [B]-wide MSM;
+    per-verkey tables are cached device-side by the backend."""
 
     def body(carry, _):
         nxt = carry
-        for _ in range(5):
+        for _ in range(window):
             nxt = jdouble(fl, nxt)
-        return nxt, carry  # emit BEFORE scaling: row w = 32^w * tables
+        return nxt, carry  # emit BEFORE scaling: row w = (2^window)^w * t
 
-    _, rows = jax.lax.scan(body, tables17, None, length=nwin)
-    # rows: [nwin(lsb-first), k, 17, L] -> msb-first, then [k, nwin, 17, L]
+    _, rows = jax.lax.scan(body, tables_e, None, length=nwin)
+    # rows: [nwin(lsb-first), k, E, L] -> msb-first, then [k, nwin, E, L]
     return jax.tree_util.tree_map(
         lambda t: jnp.moveaxis(jnp.flip(t, axis=0), 0, 1), rows
     )
@@ -270,27 +270,37 @@ def msm_shared_comb(fl, wtables, mag, sgn):
     (credential, base, window) and fold — 0 doublings, k*nwin-1 lane-adds
     per credential, all at full [B] width (no sequential window scan).
 
-    wtables: comb tables from build_comb_tables, leading [k, nwin, 17];
-    mag/sgn: signed 5-bit window digits [B, k, nwin] (msb-first,
-    digit = (-1)^sgn * mag, mag <= 16; zero scalars -> all-zero digits).
-    Returns a projective accumulator pytree with leading [B]."""
-    B, k, nwin = mag.shape
-    jidx = jnp.arange(k)[None, :, None]
-    widx = jnp.arange(nwin)[None, None, :]
+    wtables: comb tables from build_comb_tables, leading [k, nwin, E];
+    mag/sgn: signed window digits [B, k, nwin] (msb-first, digit =
+    (-1)^sgn * mag, mag <= E-1 for E-entry tables; zero scalars ->
+    all-zero digits). The backend uses the 6-bit/43-window schedule.
+    Returns a projective accumulator pytree with leading [B].
 
-    def leaf(t):  # [k, nwin, 17, L...] -> [B, k, nwin, L...]
-        return t[jidx, widx, mag]
+    Layout: the fold runs over a LEADING (k*nwin) axis with the batch in
+    the trailing lane axis — the same orientation as the grouped verify's
+    _grouped_msms fold. (The transposed [B, k*nwin] orientation miscompiles
+    on the axon TPU backend at B = 1024: the last batch row of the fold
+    comes back corrupted, data-independently, on every mul path — same
+    backend-bug family as the round-2 int8 einsum workaround in fp._school.)"""
+    B, k, nwin = mag.shape
+    jidx = jnp.arange(k)[:, None, None]
+    widx = jnp.arange(nwin)[None, :, None]
+    mag_t = jnp.transpose(mag, (1, 2, 0))  # [k, nwin, B]
+    sgn_t = jnp.transpose(sgn, (1, 2, 0))
+
+    def leaf(t):  # [k, nwin, E, L...] -> [k, nwin, B, L...]
+        return t[jidx, widx, mag_t]
 
     X, Y, Z = (
         jax.tree_util.tree_map(leaf, wtables[0]),
         jax.tree_util.tree_map(leaf, wtables[1]),
         jax.tree_util.tree_map(leaf, wtables[2]),
     )
-    Y = fl.select(sgn, fl.neg(Y), Y)
+    Y = fl.select(sgn_t, fl.neg(Y), Y)
     flat = jax.tree_util.tree_map(
-        lambda t: t.reshape((B, k * nwin) + t.shape[3:]), (X, Y, Z)
+        lambda t: t.reshape((k * nwin, B) + t.shape[3:]), (X, Y, Z)
     )
-    return fold_points_any(fl, flat, k * nwin, axis_offset=1)
+    return fold_points_any(fl, flat, k * nwin, axis_offset=0)
 
 
 def msm_distinct_signed(fl, x, y, inf, mag, sgn):

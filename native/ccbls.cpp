@@ -21,6 +21,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <vector>
 
 using u64 = uint64_t;
@@ -1222,16 +1223,16 @@ static SvdWConsts<A> svdw_derive() {
 
 static SvdWConsts<SvdWFp> SVDW_FP;
 static SvdWConsts<SvdWFp2> SVDW_FP2;
-static bool svdw_ready = false;
+static std::once_flag svdw_once;
 
 static void svdw_init() {
-  if (svdw_ready) return;
-  SVDW_FP = svdw_derive<SvdWFp>();
-  SVDW_FP2 = svdw_derive<SvdWFp2>();
-  // flag only AFTER derivation: a concurrent caller must never observe
-  // svdw_ready with zeroed constants (the derive runs long enough that the
-  // race window is real under GIL-released ctypes calls)
-  svdw_ready = true;
+  // call_once: ctypes releases the GIL during hash calls and the derive
+  // runs long enough for real thread overlap — a plain ready-flag would be
+  // a data race (flag store visible before the constant stores).
+  std::call_once(svdw_once, [] {
+    SVDW_FP = svdw_derive<SvdWFp>();
+    SVDW_FP2 = svdw_derive<SvdWFp2>();
+  });
 }
 
 // RFC 9380 §6.6.1 straight-line SvdW map (spec _map_to_curve_svdw)

@@ -553,8 +553,21 @@ class TestCycloSq:
 
 
 class TestGroupedMsms:
-    """_grouped_msms (signed 5-bit schedule) vs the spec MSM — the whole
+    """_grouped_msms (signed 6-bit schedule) vs the spec MSM — the whole
     per-credential arithmetic of the headline grouped verify."""
+
+    def test_signed6_recode_roundtrip(self):
+        from coconut_tpu.tpu.limbs import fr_digits_signed_np
+
+        ks = [rng.randrange(R) for _ in range(32)] + [0, 1, 32, 33, 63, 64, R - 1]
+        mag, neg = fr_digits_signed_np(ks, nwin=43, window=6)
+        assert mag.shape == (len(ks), 43) and int(mag.max()) <= 32
+        for k, m_row, n_row in zip(ks, mag, neg):
+            v = 0
+            for w in range(43):
+                v = v * 64 + int(m_row[w]) * (-1 if n_row[w] else 1)
+            assert v == k % R
+        assert not (neg & (mag == 0)).any()
 
     def test_matches_spec(self):
         import jax.numpy as jnp
@@ -572,7 +585,7 @@ class TestGroupedMsms:
         inf = jnp.zeros(B, dtype=bool)
         rows = [[rng.randrange(R) for _ in range(B)] for _ in range(2)]
         rows[1][3] = 0  # zero-scalar lane
-        rec = [fr_digits_signed_np(r) for r in rows]
+        rec = [fr_digits_signed_np(r, nwin=43, window=6) for r in rows]
         mag = jnp.asarray(np.stack([m for m, _ in rec]))
         sgn = jnp.asarray(np.stack([s for _, s in rec]))
         ax, ay, ainf = jax.jit(
