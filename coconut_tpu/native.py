@@ -66,6 +66,27 @@ def load(build_if_missing=True):
         fn = getattr(lib, name)
         fn.argtypes = argt
         fn.restype = None
+    lib.cc_fr_lagrange_basis_at_0.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_int,
+        ctypes.c_uint32,
+        ctypes.c_char_p,
+    ]
+    lib.cc_fr_lagrange_basis_at_0.restype = ctypes.c_int
+    lib.cc_fr_poly_eval.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_uint32,
+        ctypes.c_char_p,
+    ]
+    lib.cc_fr_poly_eval.restype = None
+    lib.cc_fr_reconstruct.argtypes = [
+        ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_char_p,
+        ctypes.c_int,
+        ctypes.c_char_p,
+    ]
+    lib.cc_fr_reconstruct.restype = ctypes.c_int
     for name in ("cc_hash_to_fr", "cc_hash_to_g1", "cc_hash_to_g2"):
         fn = getattr(lib, name)
         fn.argtypes = [
@@ -162,6 +183,73 @@ def msm_g2_single(points, scalars, force_pippenger=False):
     out = ctypes.create_string_buffer(192)
     lib.cc_msm_pippenger_g2(pts, ss, n, out)
     return _g2_parse(out.raw)
+
+
+# --- native sss (secret_sharing crate surface: Polynomial/Lagrange/Shamir,
+# reference keygen.rs:58,248, signature.rs:460,502) --------------------------
+
+
+def _id_u32(v, what="signer id"):
+    """The C ABI carries ids/eval points as uint32 — reject anything that
+    would silently wrap (sss.py accepts arbitrary ints; callers with wider
+    ids must use the Python module)."""
+    from .errors import GeneralError
+
+    v = int(v)
+    if not 0 <= v < 1 << 32:
+        raise GeneralError(
+            "%s %d outside the native uint32 range; use coconut_tpu.sss"
+            % (what, v)
+        )
+    return v
+
+
+def lagrange_basis_at_0(ids, my_id):
+    """Native l_{my_id}(0) over `ids`, bit-identical to
+    sss.lagrange_basis_at_0 (same GeneralError contract)."""
+    from .errors import GeneralError
+
+    lib = load()
+    ids = sorted({_id_u32(i) for i in ids})
+    arr = (ctypes.c_uint32 * len(ids))(*ids)
+    out = ctypes.create_string_buffer(32)
+    rc = lib.cc_fr_lagrange_basis_at_0(arr, len(ids), _id_u32(my_id), out)
+    if rc == 1:
+        raise GeneralError("id %d not in interpolation set" % my_id)
+    if rc:
+        raise GeneralError("signer ids must be nonzero (1-based)")
+    return int.from_bytes(out.raw, "little")
+
+
+def poly_eval(coeffs, x):
+    """Native Horner evaluation in Fr (the Shamir share map)."""
+    lib = load()
+    cb = b"".join((int(c) % R).to_bytes(32, "little") for c in coeffs)
+    out = ctypes.create_string_buffer(32)
+    lib.cc_fr_poly_eval(cb, len(coeffs), _id_u32(x, "eval point"), out)
+    return int.from_bytes(out.raw, "little")
+
+
+def reconstruct_secret(threshold, shares):
+    """Native Lagrange interpolation at 0, same semantics (and GeneralError
+    contract) as sss.reconstruct_secret (first `threshold` shares by id)."""
+    from .errors import GeneralError
+
+    if len(shares) < threshold:
+        raise GeneralError(
+            "need %d shares to reconstruct, got %d" % (threshold, len(shares))
+        )
+    lib = load()
+    use = sorted(shares.items())[:threshold]
+    ids = (ctypes.c_uint32 * threshold)(
+        *[_id_u32(i) for i, _ in use]
+    )
+    sb = b"".join((int(s) % R).to_bytes(32, "little") for _, s in use)
+    out = ctypes.create_string_buffer(32)
+    rc = lib.cc_fr_reconstruct(ids, sb, threshold, out)
+    if rc:
+        raise GeneralError("invalid share ids")
+    return int.from_bytes(out.raw, "little")
 
 
 def derive_params(msg_count, label):

@@ -923,6 +923,161 @@ static Fp12 final_exp(const Fp12 &f) {
 }
 
 // ---------------------------------------------------------------------------
+// Fr: scalar field, 4x64 Montgomery (R_mont = 2^256) — the native `sss`
+// arithmetic (replaces the reference's external secret_sharing crate,
+// Cargo.toml:14: Polynomial/Lagrange/Shamir surfaces at keygen.rs:58,248
+// and signature.rs:460,502). Protocol-layer math, var-time (ids/shares
+// are not long-term secrets on the aggregation path the reference also
+// runs var-time, signature.rs:513,521).
+// ---------------------------------------------------------------------------
+
+struct Fr {
+  u64 v[4];
+};
+
+// r (the BLS12-381 scalar-field modulus), LE limbs
+static const u64 RL[4] = {0xffffffff00000001ULL, 0x53bda402fffe5bfeULL,
+                          0x3339d80809a1d805ULL, 0x73eda753299d7d48ULL};
+// -r^{-1} mod 2^64; 2^512 mod r (Montgomery RR)
+static const u64 R_N0 = 0xfffffffeffffffffULL;
+static const u64 R_RR[4] = {0xc999e990f3f29c6dULL, 0x2b6cedcb87925c23ULL,
+                            0x05d314967254398fULL, 0x0748d9d99f59ff11ULL};
+static const u64 R_M2[4] = {0xfffffffeffffffffULL, 0x53bda402fffe5bfeULL,
+                            0x3339d80809a1d805ULL, 0x73eda753299d7d48ULL};
+
+static const Fr FR_ZERO = {{0, 0, 0, 0}};
+static Fr FR_ONE;  // mont(1), set in fr_init
+
+static inline void fr_cond_sub_r(Fr &a, u64 force) {
+  u64 t[4];
+  u128 borrow = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 d = (u128)a.v[i] - RL[i] - borrow;
+    t[i] = (u64)d;
+    borrow = (d >> 64) & 1;
+  }
+  u64 mask = (u64)0 - (force | (u64)(1 - (u64)borrow));
+  for (int i = 0; i < 4; i++) a.v[i] = (a.v[i] & ~mask) | (t[i] & mask);
+}
+
+static inline Fr fr_add(const Fr &a, const Fr &b) {
+  Fr r;
+  u128 carry = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 s = (u128)a.v[i] + b.v[i] + carry;
+    r.v[i] = (u64)s;
+    carry = s >> 64;
+  }
+  fr_cond_sub_r(r, (u64)carry);
+  return r;
+}
+
+static inline Fr fr_sub(const Fr &a, const Fr &b) {
+  Fr r;
+  u128 borrow = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 d = (u128)a.v[i] - b.v[i] - borrow;
+    r.v[i] = (u64)d;
+    borrow = (d >> 64) & 1;
+  }
+  u64 mask = (u64)0 - (u64)borrow;
+  u128 carry = 0;
+  for (int i = 0; i < 4; i++) {
+    u128 s = (u128)r.v[i] + (RL[i] & mask) + carry;
+    r.v[i] = (u64)s;
+    carry = s >> 64;
+  }
+  return r;
+}
+
+static inline Fr fr_mul(const Fr &a, const Fr &b) {  // CIOS Montgomery
+  u64 t[6] = {0};
+  for (int i = 0; i < 4; i++) {
+    u128 carry = 0;
+    for (int j = 0; j < 4; j++) {
+      u128 s = (u128)t[j] + (u128)a.v[i] * b.v[j] + carry;
+      t[j] = (u64)s;
+      carry = s >> 64;
+    }
+    u128 s = (u128)t[4] + carry;
+    t[4] = (u64)s;
+    t[5] = (u64)(s >> 64);
+    u64 m = t[0] * R_N0;
+    carry = ((u128)t[0] + (u128)m * RL[0]) >> 64;
+    for (int j = 1; j < 4; j++) {
+      u128 s2 = (u128)t[j] + (u128)m * RL[j] + carry;
+      t[j - 1] = (u64)s2;
+      carry = s2 >> 64;
+    }
+    s = (u128)t[4] + carry;
+    t[3] = (u64)s;
+    t[4] = t[5] + (u64)(s >> 64);
+    t[5] = 0;
+  }
+  Fr r;
+  memcpy(r.v, t, 32);
+  fr_cond_sub_r(r, (u64)(t[4] != 0));
+  return r;
+}
+
+static Fr fr_pow(const Fr &a, const u64 *e, int nl) {
+  Fr r = a;
+  bool started = false;
+  for (int i = nl - 1; i >= 0; i--)
+    for (int bit = 63; bit >= 0; bit--) {
+      if (started) {
+        r = fr_mul(r, r);
+        if ((e[i] >> bit) & 1) r = fr_mul(r, a);
+      } else if ((e[i] >> bit) & 1) {
+        started = true;
+      }
+    }
+  return r;
+}
+
+static inline Fr fr_inv(const Fr &a) { return fr_pow(a, R_M2, 4); }
+
+static void fr_init() {
+  // call_once for the same reason as svdw_init: ctypes releases the GIL,
+  // and fr_init is not forced at load time by cc_selftest.
+  static std::once_flag once;
+  std::call_once(once, [] {
+    Fr raw1 = {{1, 0, 0, 0}};
+    Fr rr;
+    memcpy(rr.v, R_RR, 32);
+    FR_ONE = fr_mul(raw1, rr);
+  });
+}
+
+static Fr fr_from_le(const uint8_t *b) {  // canonical LE -> Montgomery
+  fr_init();
+  Fr a;
+  for (int i = 0; i < 4; i++) {
+    u64 w = 0;
+    for (int j = 0; j < 8; j++) w |= (u64)b[i * 8 + j] << (8 * j);
+    a.v[i] = w;
+  }
+  Fr rr;
+  memcpy(rr.v, R_RR, 32);
+  return fr_mul(a, rr);
+}
+
+static void fr_to_le(const Fr &a, uint8_t *b) {
+  Fr one = {{1, 0, 0, 0}};
+  Fr c = fr_mul(a, one);
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < 8; j++) b[i * 8 + j] = (uint8_t)(c.v[i] >> (8 * j));
+}
+
+static Fr fr_from_u64(u64 x) {
+  fr_init();
+  Fr a = {{x, 0, 0, 0}};
+  Fr rr;
+  memcpy(rr.v, R_RR, 32);
+  return fr_mul(a, rr);
+}
+
+// ---------------------------------------------------------------------------
 // Hashing to fields and groups — native implementation of the framework's
 // CTH-v2 spec (coconut_tpu/ops/hashing.py): expand_message_xmd (SHA-256,
 // RFC 9380 §5.3.1 construction), hash_to_fr/fp, and the Shallue-van de
@@ -1074,8 +1229,6 @@ static void bytes_mod(const uint8_t *be, size_t len, const u64 *mod, int nl,
   for (int j = 0; j < nl; j++) out[j] = acc[j];
 }
 
-static const u64 RL[4] = {0xffffffff00000001ULL, 0x53bda402fffe5bfeULL,
-                          0x3339d80809a1d805ULL, 0x73eda753299d7d48ULL};
 static const u64 G1_COF[2] = {0x8c00aaab0000aaabULL, 0x396c8c005555e156ULL};
 static const u64 G2_COF[8] = {
     0xcf1c38e31c7238e5ULL, 0x1616ec6e786f0c70ULL, 0x21537e293a6691aeULL,
@@ -1506,6 +1659,63 @@ void cc_g1_mul(const uint8_t *pts, const uint8_t *scalars, int B,
     jac_to_affine(acc, ox, oy, oinf);
     g1_store(out + (size_t)i * 96, ox, oy, oinf);
   }
+}
+
+// --- native sss: Lagrange / Shamir over Fr (secret_sharing crate surface,
+// keygen.rs:58,248; signature.rs:460,502) --------------------------------
+
+// l_{my_id}(0) over the (1-based, gap-tolerant) interpolation set `ids`:
+// prod_{j != i} x_j / (x_j - x_i) mod r. out32 = canonical LE. Returns 0
+// on success, nonzero if my_id is missing from ids or any id is 0.
+int cc_fr_lagrange_basis_at_0(const uint32_t *ids, int n, uint32_t my_id,
+                              uint8_t *out32) {
+  fr_init();
+  bool found = false;
+  for (int j = 0; j < n; j++) {
+    if (ids[j] == 0) return 2;
+    if (ids[j] == my_id) found = true;
+  }
+  if (!found) return 1;
+  Fr num = FR_ONE, den = FR_ONE;
+  Fr mid = fr_from_u64(my_id);
+  for (int j = 0; j < n; j++) {
+    if (ids[j] == my_id) continue;
+    Fr xj = fr_from_u64(ids[j]);
+    num = fr_mul(num, xj);
+    den = fr_mul(den, fr_sub(xj, mid));
+  }
+  fr_to_le(fr_mul(num, fr_inv(den)), out32);
+  return 0;
+}
+
+// Horner evaluation of a k-coefficient polynomial (a0 first, 32B LE each)
+// at integer x — the Shamir share map (keygen.rs:58).
+void cc_fr_poly_eval(const uint8_t *coeffs, int k, uint32_t x,
+                     uint8_t *out32) {
+  fr_init();
+  Fr acc = FR_ZERO;
+  Fr xf = fr_from_u64(x);
+  for (int i = k - 1; i >= 0; i--) {
+    acc = fr_add(fr_mul(acc, xf), fr_from_le(coeffs + (size_t)i * 32));
+  }
+  fr_to_le(acc, out32);
+}
+
+// Lagrange-interpolate the secret at 0 from t (id, share) pairs
+// (keygen.rs:248): out = sum_i l_i(0) * s_i. Returns 0 on success.
+int cc_fr_reconstruct(const uint32_t *ids, const uint8_t *shares, int t,
+                      uint8_t *out32) {
+  fr_init();
+  Fr acc = FR_ZERO;
+  for (int i = 0; i < t; i++) {
+    uint8_t lb[32];
+    int rc = cc_fr_lagrange_basis_at_0(ids, t, ids[i], lb);
+    if (rc) return rc;
+    acc = fr_add(acc,
+                 fr_mul(fr_from_le(lb), fr_from_le(shares + (size_t)i * 32)));
+  }
+  fr_to_le(acc, out32);
+  return 0;
 }
 
 // ONE Pippenger bucket MSM over n distinct G1 points (var-time, public

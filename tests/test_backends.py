@@ -219,15 +219,18 @@ class TestCombinedVerify:
         )
         assert ok2 is True
 
-    def test_forgery_rejected_tiny_shapes(self):
+    @pytest.mark.parametrize("ctx_name", ["G1", "G2"])
+    def test_forgery_rejected_tiny_shapes(self, ctx_name):
         """Soundness of the probabilistic one-bool paths in the DEFAULT
         suite (VERDICT r2 weak #1): B=2 / q=1 keeps the XLA compile to
         seconds on the CPU mesh while exercising the combiner algebra's
-        reject behavior end to end."""
+        reject behavior end to end — under BOTH group assignments (the
+        grouped kernel's sig_fl/oth_fl roles flip with the ctx)."""
         from coconut_tpu.backend import get_backend
+        from coconut_tpu.params import GroupContext
 
         be = get_backend("jax")
-        tiny = Params.new(1, b"tiny-soundness")
+        tiny = Params.new(1, b"tiny-soundness", ctx=GroupContext(ctx_name))
         sk = Sigkey(rng.randrange(1, R), [rng.randrange(1, R)])
         ops = tiny.ctx.other
         vk = Verkey(
@@ -425,6 +428,38 @@ class TestPippenger:
         p2 = [g2.mul(G2_GEN, rng.randrange(1, R)) for _ in range(100)]
         s2 = [rng.randrange(R) for _ in range(100)]
         assert native.msm_g2_single(p2, s2) == g2.msm(p2, s2)
+
+
+class TestNativeSss:
+    """Native Fr Lagrange/Shamir (the secret_sharing crate surface,
+    keygen.rs:58,248, signature.rs:460,502) vs the Python sss module —
+    including the gap-id edge cases the reference tests hardest."""
+
+    def test_matches_python_sss(self):
+        from coconut_tpu import native, sss
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        # lagrange over gap-containing id sets
+        from coconut_tpu.errors import GeneralError
+
+        for ids in ({1, 2, 3}, {2, 5, 7}, {1, 4, 9, 11, 30}):
+            for i in ids:
+                assert native.lagrange_basis_at_0(
+                    ids, i
+                ) == sss.lagrange_basis_at_0(ids, i)
+        with pytest.raises(GeneralError):
+            native.lagrange_basis_at_0({1, 2}, 3)
+        with pytest.raises(GeneralError):  # uint32 ABI range guard
+            native.lagrange_basis_at_0({1, 1 << 33}, 1)
+        # poly eval + full shamir round trip through the native side
+        coeffs = sss.poly_random(3)
+        for x in (1, 2, 77):
+            assert native.poly_eval(coeffs, x) == sss.poly_eval(coeffs, x)
+        secret, shares = sss.get_shared_secret(3, 5)
+        sub = {i: shares[i] for i in (1, 3, 5)}
+        assert native.reconstruct_secret(3, sub) == secret
+        assert sss.reconstruct_secret(3, sub) == secret
 
 
 class TestConstTimeMsm:
