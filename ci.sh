@@ -26,4 +26,11 @@ fi
 
 echo "== driver probes =="
 python -c "import __graft_entry__" # imports compile-check the entry wiring
+# Run the multi-chip dryrun exactly as the driver does (8-device virtual CPU
+# mesh). tests/test_shard.py compiled these exact programs above, so this is
+# warm-seconds from the persistent cache — and it keeps the cache seeded so
+# the driver's MULTICHIP probe never pays a cold compile (VERDICT r3 item 1).
+XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" \
+  JAX_PLATFORMS=cpu \
+  python -c "import __graft_entry__ as ge; ge.dryrun_multichip(8)"
 echo "ci: ok"

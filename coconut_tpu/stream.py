@@ -71,12 +71,34 @@ class StreamState:
         os.replace(tmp, self.path)  # atomic on POSIX
 
 
-def _dispatchers(backend, mode):
+def _dispatchers(backend, mode, mesh=None):
     """(dispatch, record, is_async) for the chosen mode. dispatch(sigs,
     msgs, vk, params) -> zero-arg finalizer; record(state, result,
     batch_size). is_async=False means dispatch computes synchronously —
     pipelining such a backend would only delay checkpoints, never overlap
-    work, so verify_stream settles each batch immediately."""
+    work, so verify_stream settles each batch immediately.
+
+    mesh: run the grouped mode dp-sharded over a jax Mesh (config 5 on
+    multi-chip — SURVEY §2.3 PP+DP rows combined: the batch is sharded
+    across devices AND host encode pipelines under device execution)."""
+    if mesh is not None:
+        if mode != "grouped":
+            raise ValueError(
+                "mesh streaming requires mode='grouped' (got %r)" % (mode,)
+            )
+        if not hasattr(backend, "encode_grouped_batch"):
+            raise ValueError(
+                "backend %r cannot shard over a mesh (no "
+                "encode_grouped_batch); use the jax backend" % (backend,)
+            )
+        from .tpu import shard as _shard
+
+        def dispatch(s, m, vk, params):
+            return _shard.batch_verify_grouped_sharded_async(
+                backend, s, m, vk, params, mesh
+            )
+
+        return dispatch, _record_grouped, True
     if mode == "per_credential":
         async_fn = getattr(backend, "batch_verify_async", None)
         if async_fn is None:
@@ -109,16 +131,19 @@ def _dispatchers(backend, mode):
         else:
             dispatch = async_fn
 
-        def record(state, ok, n):
-            if ok:
-                state.batches_ok += 1
-                state.verified += n
-            else:
-                state.batches_failed += 1
-                state.failed += n
-
-        return dispatch, record, async_fn is not None
+        return dispatch, _record_grouped, async_fn is not None
     raise ValueError("unknown stream mode %r" % (mode,))
+
+
+def _record_grouped(state, ok, n):
+    """Grouped-mode accounting (single-chip and mesh paths share it): one
+    bool covers the whole batch, so tallies move batch-wholesale."""
+    if ok:
+        state.batches_ok += 1
+        state.verified += n
+    else:
+        state.batches_failed += 1
+        state.failed += n
 
 
 def verify_stream(
@@ -131,6 +156,7 @@ def verify_stream(
     on_batch=None,
     mode="per_credential",
     pipeline=True,
+    mesh=None,
 ):
     """Verify `n_batches` batches from `source(i) -> (sigs, messages_list)`.
 
@@ -139,12 +165,13 @@ def verify_stream(
     with the mode's result type (bools list / one bool) — the hook for
     collecting results or metrics. `pipeline=True` overlaps host encode of
     batch i+1 with device execution of batch i when the backend supports
-    async dispatch."""
+    async dispatch. `mesh` dp-shards the grouped mode over a jax Mesh
+    (multi-chip config 5)."""
     from .backend import get_backend
 
     if backend is None or isinstance(backend, str):
         backend = get_backend(backend or "python")
-    dispatch, record, is_async = _dispatchers(backend, mode)
+    dispatch, record, is_async = _dispatchers(backend, mode, mesh=mesh)
     pipeline = pipeline and is_async  # sync backends: settle immediately
     state = StreamState(state_path)
 

@@ -10,3 +10,30 @@ static BLS parameter bits, and the whole credential-verification hot path
 (reference signature.rs:472-478) compiles to one fused XLA program per batch
 shape. No 64-bit lane support is required — everything is f32/bf16/int32.
 """
+
+import os as _os
+
+
+def enable_compile_cache():
+    """Point jax at the repo's persistent compile cache (.jax_cache).
+
+    The fused/sharded programs take minutes to compile cold on a 1-core
+    host. ONE definition, shared by tests/conftest.py, bench.py, and
+    __graft_entry__ — round 3's driver MULTICHIP timeout happened because
+    the three call sites were hand-copied and one copy was missing
+    (VERDICT r3 item 1). JAX_CACHE_DIR overrides the location."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        _os.environ.get(
+            "JAX_CACHE_DIR",
+            _os.path.join(
+                _os.path.dirname(
+                    _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+                ),
+                ".jax_cache",
+            ),
+        ),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)

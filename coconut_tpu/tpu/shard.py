@@ -176,13 +176,15 @@ def make_sharded_grouped_verify(mesh, sig_is_g1, batch_axis="dp"):
 
 
 def batch_verify_grouped_sharded(
-    backend, sigs, messages_list, vk, params, mesh, batch_axis="dp"
+    backend, sigs, messages_list, vk, params, mesh, batch_axis="dp",
+    pad_batch_to=None,
 ):
     """dp-sharded attribute-grouped batch verify on a mesh: ONE bool for
     the whole batch, same semantics (and 2^-128 soundness) as
     `JaxBackend.batch_verify_grouped`. The batch is padded to a power of
-    two divisible by the dp extent; per-device slices stay powers of two
-    (fold_points requires it)."""
+    two divisible by the dp extent (pad_batch_to, default 2x the dp
+    extent; the dryrun passes ndp for the one-lane-per-device minimum);
+    per-device slices stay powers of two (fold_points requires it)."""
     ndp = mesh.shape[batch_axis]
     if ndp & (ndp - 1):
         raise ValueError("dp extent %d must be a power of two" % ndp)
@@ -191,12 +193,118 @@ def batch_verify_grouped_sharded(
     if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
         return False
     operands = backend.encode_grouped_batch(
-        sigs, messages_list, vk, params, pad_batch_to=2 * ndp
+        sigs, messages_list, vk, params,
+        pad_batch_to=2 * ndp if pad_batch_to is None else pad_batch_to,
     )
     fn = make_sharded_grouped_verify(
         mesh, params.ctx.name == "G1", batch_axis
     )
     return bool(fn(*operands))
+
+
+def batch_verify_grouped_sharded_async(
+    backend, sigs, messages_list, vk, params, mesh, batch_axis="dp",
+    pad_batch_to=None,
+):
+    """Pipelined variant of `batch_verify_grouped_sharded`: dispatches the
+    sharded grouped program (JAX dispatch is asynchronous) and returns a
+    zero-arg finalizer, so `stream.verify_stream` can overlap batch i+1's
+    host encode with batch i's mesh execution — config 5 on a mesh."""
+    ndp = mesh.shape[batch_axis]
+    if ndp & (ndp - 1):
+        raise ValueError("dp extent %d must be a power of two" % ndp)
+    if len(sigs) == 0:
+        return lambda: True
+    if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
+        return lambda: False
+    operands = backend.encode_grouped_batch(
+        sigs, messages_list, vk, params,
+        pad_batch_to=2 * ndp if pad_batch_to is None else pad_batch_to,
+    )
+    fn = make_sharded_grouped_verify(
+        mesh, params.ctx.name == "G1", batch_axis
+    )
+    ok = fn(*operands)
+    return lambda: bool(ok)
+
+
+def make_sharded_show_verify(mesh, sig_is_g1, batch_axis="dp"):
+    """dp-sharded batched show-verify (config 3 on a mesh): each device runs
+    the fused Schnorr + pairing checks (backend.fused_show_verify) on its
+    slice of proofs; bits are per-proof, so no cross-device combine is
+    needed — the output stays dp-sharded and gathers on readback."""
+    key = ("show", mesh, sig_is_g1, batch_axis)
+    cached = _PROGRAM_CACHE.get(key)
+    if cached is not None:
+        return cached
+
+    def local(*ops):
+        return bk.fused_show_verify(sig_is_g1, *ops)
+
+    dp = P(batch_axis)
+    in_specs = (
+        P(),  # vc_wtables (shared Schnorr bases, replicated)
+        dp,  # resp_mag [B, k, nwin]
+        dp,  # resp_sgn
+        dp,  # jpt (J coordinate pytree, leading [B])
+        dp,  # jinf
+        dp,  # cmag_j [B, 1, nwin]
+        dp,  # csgn_j
+        dp,  # commx
+        dp,  # commy
+        dp,  # comminf
+        P(),  # acc_wtables (replicated)
+        dp,  # acc_mag
+        dp,  # acc_sgn
+        dp,  # s1
+        dp,  # s2n
+        P(),  # gtx
+        P(),  # gty
+        dp,  # inf1
+        dp,  # inf2
+    )
+    try:
+        fn = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P(batch_axis),
+            check_vma=False,
+        )
+    except TypeError:  # pragma: no cover - jax < 0.4.35 spelling
+        fn = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=P(batch_axis),
+            check_rep=False,
+        )
+    jitted = jax.jit(fn)
+    _PROGRAM_CACHE[key] = jitted
+    return jitted
+
+
+def batch_show_verify_sharded(
+    backend, proofs, vk, params, revealed_msgs_list, challenges, mesh,
+    batch_axis="dp",
+):
+    """dp-sharded batched PoKOfSignatureProof.verify on a mesh: [B] bools,
+    bit-identical to `JaxBackend.batch_show_verify` (reference surface
+    pok_sig.rs:103-105). The proof batch must divide the dp extent."""
+    ndp = mesh.shape[batch_axis]
+    if len(proofs) % ndp:
+        raise ValueError(
+            "batch size %d not divisible by %s=%d"
+            % (len(proofs), batch_axis, ndp)
+        )
+    operands = backend.encode_show_verify_batch(
+        proofs, vk, params, revealed_msgs_list, challenges
+    )
+    fn = make_sharded_show_verify(
+        mesh, params.ctx.name == "G1", batch_axis
+    )
+    bits = fn(*operands)
+    return [bool(b) for b in np.asarray(bits)]
 
 
 def pad_to_multiple(k, n):

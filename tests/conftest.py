@@ -27,10 +27,12 @@ try:
     jax.config.update("jax_platforms", "cpu")
     # persistent compile cache: the jax-backend differential tests compile
     # multi-minute XLA programs on the CPU mesh; cache them across runs
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.environ.get("JAX_CACHE_DIR", os.path.join(os.path.dirname(__file__), "..", ".jax_cache")),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+    # (one shared definition — see coconut_tpu/tpu/__init__.py)
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+    import coconut_tpu.tpu
+
+    coconut_tpu.tpu.enable_compile_cache()
 except ImportError:  # pragma: no cover - jax is baked into this image
     pass
