@@ -87,6 +87,45 @@ def load(build_if_missing=True):
         ctypes.c_char_p,
     ]
     lib.cc_fr_reconstruct.restype = ctypes.c_int
+    lib.cc_fr_random.argtypes = [ctypes.c_char_p]
+    lib.cc_fr_random.restype = ctypes.c_int
+    lib.cc_pedersen_deal_from_coeffs.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p,
+    ]
+    lib.cc_pedersen_deal_from_coeffs.restype = None
+    lib.cc_pedersen_deal.argtypes = [
+        ctypes.c_int, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p,
+    ]
+    lib.cc_pedersen_deal.restype = ctypes.c_int
+    lib.cc_pedersen_verify_share.argtypes = [
+        ctypes.c_int, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.cc_pedersen_verify_share.restype = ctypes.c_int
+    lib.cc_dvss_new.argtypes = [
+        ctypes.c_uint32, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_char_p,
+    ]
+    lib.cc_dvss_new.restype = ctypes.c_void_p
+    lib.cc_dvss_deal.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.cc_dvss_deal.restype = None
+    lib.cc_dvss_receive.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_char_p,
+    ]
+    lib.cc_dvss_receive.restype = ctypes.c_int
+    lib.cc_dvss_finalize.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+    ]
+    lib.cc_dvss_finalize.restype = ctypes.c_int
+    lib.cc_dvss_free.argtypes = [ctypes.c_void_p]
+    lib.cc_dvss_free.restype = None
     for name in ("cc_hash_to_fr", "cc_hash_to_g1", "cc_hash_to_g2"):
         fn = getattr(lib, name)
         fn.argtypes = [
@@ -250,6 +289,241 @@ def reconstruct_secret(threshold, shares):
     if rc:
         raise GeneralError("invalid share ids")
     return int.from_bytes(out.raw, "little")
+
+
+# --- native Pedersen VSS / DVSS (finishes the secret_sharing rebuild
+# target: reference keygen.rs:74-205; differential tests vs sss.py in
+# tests/test_backends.py) ----------------------------------------------------
+
+
+def rand_fr():
+    """Native uniform Fr from OS entropy (FieldElement::random surface)."""
+    lib = load()
+    out = ctypes.create_string_buffer(32)
+    if lib.cc_fr_random(out):
+        raise RuntimeError("native entropy source failed")
+    return int.from_bytes(out.raw, "little")
+
+
+def pedersen_deal_from_coeffs(threshold, total, g, h, f_coeffs, g_coeffs):
+    """Native Pedersen deal from given polynomial coefficients: returns
+    (comm_coeffs {j: point}, s_shares {id: int}, t_shares {id: int}).
+    Bit-identical to the sss.py math on the same coefficients."""
+    from .errors import GeneralError
+
+    if not 0 < threshold <= total:
+        raise GeneralError(
+            "invalid threshold %d for total %d" % (threshold, total)
+        )
+    if len(f_coeffs) != threshold or len(g_coeffs) != threshold:
+        raise GeneralError(
+            "need %d coefficients per polynomial, got %d and %d"
+            % (threshold, len(f_coeffs), len(g_coeffs))
+        )
+    lib = load()
+    fc = b"".join(_scalar_bytes(c) for c in f_coeffs)
+    gc = b"".join(_scalar_bytes(c) for c in g_coeffs)
+    comms = ctypes.create_string_buffer(96 * threshold)
+    ss = ctypes.create_string_buffer(32 * total)
+    ts = ctypes.create_string_buffer(32 * total)
+    lib.cc_pedersen_deal_from_coeffs(
+        threshold, total, _g1_bytes(g), _g1_bytes(h), fc, gc, comms, ss, ts
+    )
+    comm_coeffs = {
+        j: _g1_parse(comms.raw[j * 96 : (j + 1) * 96])
+        for j in range(threshold)
+    }
+    s_shares = {
+        i: int.from_bytes(ss.raw[(i - 1) * 32 : i * 32], "little")
+        for i in range(1, total + 1)
+    }
+    t_shares = {
+        i: int.from_bytes(ts.raw[(i - 1) * 32 : i * 32], "little")
+        for i in range(1, total + 1)
+    }
+    return comm_coeffs, s_shares, t_shares
+
+
+def pedersen_deal(threshold, total, g, h):
+    """Native PedersenVSS::deal (keygen.rs:93-94): fresh random polynomials
+    from native entropy. Returns (secret, blind_secret, comm_coeffs,
+    s_shares, t_shares) — the sss.PedersenVSS.deal tuple."""
+    from .errors import GeneralError
+
+    if not 0 < threshold <= total:
+        raise GeneralError(
+            "invalid threshold %d for total %d" % (threshold, total)
+        )
+    lib = load()
+    fc = ctypes.create_string_buffer(32 * threshold)
+    gc = ctypes.create_string_buffer(32 * threshold)
+    comms = ctypes.create_string_buffer(96 * threshold)
+    ss = ctypes.create_string_buffer(32 * total)
+    ts = ctypes.create_string_buffer(32 * total)
+    if lib.cc_pedersen_deal(
+        threshold, total, _g1_bytes(g), _g1_bytes(h), fc, gc, comms, ss, ts
+    ):
+        raise RuntimeError("native entropy source failed")
+    comm_coeffs = {
+        j: _g1_parse(comms.raw[j * 96 : (j + 1) * 96])
+        for j in range(threshold)
+    }
+    s_shares = {
+        i: int.from_bytes(ss.raw[(i - 1) * 32 : i * 32], "little")
+        for i in range(1, total + 1)
+    }
+    t_shares = {
+        i: int.from_bytes(ts.raw[(i - 1) * 32 : i * 32], "little")
+        for i in range(1, total + 1)
+    }
+    secret = int.from_bytes(fc.raw[:32], "little")
+    blind = int.from_bytes(gc.raw[:32], "little")
+    return secret, blind, comm_coeffs, s_shares, t_shares
+
+
+def pedersen_verify_share(threshold, share_id, share, comm_coeffs, g, h):
+    """Native PedersenVSS::verify_share (keygen.rs:334-351)."""
+    lib = load()
+    s, t = share
+    comms = b"".join(
+        _g1_bytes(comm_coeffs[j]) for j in range(threshold)
+    )
+    return bool(
+        lib.cc_pedersen_verify_share(
+            threshold,
+            _id_u32(share_id),
+            _scalar_bytes(s),
+            _scalar_bytes(t),
+            comms,
+            _g1_bytes(g),
+            _g1_bytes(h),
+        )
+    )
+
+
+class DvssParticipant:
+    """Native DVSS participant (reference PedersenDVSSParticipant surface,
+    keygen.rs:136-162): the dealing, share verification, and combining run
+    in C++; the protocol driver stays host-side like the reference's.
+
+    Mirrors sss.PedersenDVSSParticipant's attribute surface so the two are
+    interchangeable in the keygen drivers and differential tests."""
+
+    def __init__(self, participant_id, threshold, total, g, h):
+        from .errors import GeneralError
+
+        lib = load()
+        self._lib = lib
+        self.id = _id_u32(participant_id)
+        self.threshold = threshold
+        self.total = total
+        self._h = lib.cc_dvss_new(
+            self.id, threshold, total, _g1_bytes(g), _g1_bytes(h)
+        )
+        if not self._h:
+            raise GeneralError(
+                "invalid DVSS parameters id=%d t=%d n=%d"
+                % (participant_id, threshold, total)
+            )
+        comms = ctypes.create_string_buffer(96 * threshold)
+        ss = ctypes.create_string_buffer(32 * total)
+        ts = ctypes.create_string_buffer(32 * total)
+        lib.cc_dvss_deal(self._h, comms, ss, ts)
+        self.comm_coeffs = {
+            j: _g1_parse(comms.raw[j * 96 : (j + 1) * 96])
+            for j in range(threshold)
+        }
+        self.s_shares = {
+            i: int.from_bytes(ss.raw[(i - 1) * 32 : i * 32], "little")
+            for i in range(1, total + 1)
+        }
+        self.t_shares = {
+            i: int.from_bytes(ts.raw[(i - 1) * 32 : i * 32], "little")
+            for i in range(1, total + 1)
+        }
+        self.secret_share = None
+        self.t_secret_share = None
+        self.final_comm_coeffs = None
+
+    def received_share(self, from_id, comm_coeffs, share, threshold=None,
+                       total=None, g=None, h=None):
+        """Verify and store a share of `from_id`'s secret (the extra args
+        of the sss.py surface are carried by the native handle)."""
+        from .errors import GeneralError
+
+        s, t = share
+        comms = b"".join(
+            _g1_bytes(comm_coeffs[j]) for j in range(self.threshold)
+        )
+        rc = self._lib.cc_dvss_receive(
+            self._h,
+            _id_u32(from_id),
+            comms,
+            _scalar_bytes(s),
+            _scalar_bytes(t),
+        )
+        if rc == 1:
+            raise GeneralError(
+                "participant %d received its own share" % self.id
+            )
+        if rc == 2:
+            raise GeneralError("participant id %d out of range" % from_id)
+        if rc == 3:
+            raise GeneralError(
+                "participant %d already has a share from %d"
+                % (self.id, from_id)
+            )
+        if rc:
+            raise GeneralError(
+                "share from participant %d failed verification at %d"
+                % (from_id, self.id)
+            )
+
+    def compute_final_comm_coeffs_and_shares(self, threshold=None,
+                                             total=None, g=None, h=None):
+        from .errors import GeneralError
+
+        s32 = ctypes.create_string_buffer(32)
+        t32 = ctypes.create_string_buffer(32)
+        comms = ctypes.create_string_buffer(96 * self.threshold)
+        rc = self._lib.cc_dvss_finalize(self._h, s32, t32, comms)
+        if rc:
+            raise GeneralError(
+                "participant %d is missing pairwise shares" % self.id
+            )
+        self.secret_share = int.from_bytes(s32.raw, "little")
+        self.t_secret_share = int.from_bytes(t32.raw, "little")
+        self.final_comm_coeffs = {
+            j: _g1_parse(comms.raw[j * 96 : (j + 1) * 96])
+            for j in range(self.threshold)
+        }
+
+    def __del__(self):
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.cc_dvss_free(h)
+            self._h = None
+
+
+def share_secret_dvss(threshold, total, g, h):
+    """Native-participant version of sss.share_secret_dvss: the full
+    dealerless 3-round protocol simulated in-process (keygen.rs:126-165)."""
+    participants = [
+        DvssParticipant(i, threshold, total, g, h)
+        for i in range(1, total + 1)
+    ]
+    for recv in participants:
+        for sender in participants:
+            if sender.id == recv.id:
+                continue
+            recv.received_share(
+                sender.id,
+                sender.comm_coeffs,
+                (sender.s_shares[recv.id], sender.t_shares[recv.id]),
+            )
+    for p in participants:
+        p.compute_final_comm_coeffs_and_shares()
+    return participants
 
 
 def derive_params(msg_count, label):

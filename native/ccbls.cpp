@@ -22,6 +22,7 @@
 #include <cstdint>
 #include <cstring>
 #include <mutex>
+#include <sys/random.h>
 #include <vector>
 
 using u64 = uint64_t;
@@ -1543,6 +1544,49 @@ static void ccbls_init() {
   for (int i = 0; i < 6; i++) G2C[i] = fp2_mul(G1C[i], fp2_conj(G1C[i]));
 }
 
+// Var-time 4-bit-window single G1 scalar mult (public data). Shared by
+// cc_g1_mul and the Pedersen commitment-side checks.
+static Jac<Fp> g1_smul(const Fp &x, const Fp &y, const Scalar &s) {
+  Jac<Fp> acc = jac_inf<Fp>();
+  for (int w = 0; w < 64; w++) {
+    if (w)
+      for (int d = 0; d < 4; d++) acc = jac_double(acc);
+    unsigned dg = scalar_window(s, w);
+    if (dg) {
+      Jac<Fp> t = jac_inf<Fp>();
+      for (unsigned b = 0; b < dg; b++) t = jac_add_affine(t, x, y, false);
+      acc = jac_add(acc, t);
+    }
+  }
+  return acc;
+}
+
+// rows x (g^{s_i} h^{t_i}) through the masked-lookup CONST-TIME schedule —
+// the exponents are secrets (Pedersen VSS coefficients and shares; the
+// reference's const-time discipline at its MSM call sites,
+// signature.rs:157,424-428, applies to the keygen side too).
+static void pedersen_ct_rows(const uint8_t *g96, const uint8_t *h96,
+                             const uint8_t *srows, const uint8_t *trows,
+                             int rows, uint8_t *out96) {
+  Fp bx[2], by[2];
+  bool binf[2];
+  binf[0] = g1_load(g96, bx[0], by[0]);
+  binf[1] = g1_load(h96, bx[1], by[1]);
+  std::vector<Jac<Fp>> tables;
+  msm_tables<Fp>(bx, by, binf, 2, tables);
+  std::vector<Proj<Fp>> ptables;
+  msm_tables_proj(tables, 2, ptables);
+  for (int i = 0; i < rows; i++) {
+    Scalar s2[2] = {scalar_load(srows + (size_t)i * 32),
+                    scalar_load(trows + (size_t)i * 32)};
+    Proj<Fp> acc = msm_row_ct<Fp>(ptables, s2, 2);
+    Fp x, y;
+    bool inf;
+    proj_to_affine(acc, x, y, inf);
+    g1_store(out96 + (size_t)i * 96, x, y, inf);
+  }
+}
+
 // ---------------------------------------------------------------------------
 // C ABI
 // ---------------------------------------------------------------------------
@@ -1643,17 +1687,7 @@ void cc_g1_mul(const uint8_t *pts, const uint8_t *scalars, int B,
       g1_store(out + (size_t)i * 96, FP_ZERO, FP_ZERO, true);
       continue;
     }
-    Jac<Fp> acc = jac_inf<Fp>();
-    for (int w = 0; w < 64; w++) {
-      if (w)
-        for (int d = 0; d < 4; d++) acc = jac_double(acc);
-      unsigned dg = scalar_window(s, w);
-      if (dg) {
-        Jac<Fp> t = jac_inf<Fp>();
-        for (unsigned b = 0; b < dg; b++) t = jac_add_affine(t, x, y, false);
-        acc = jac_add(acc, t);
-      }
-    }
+    Jac<Fp> acc = g1_smul(x, y, s);
     Fp ox, oy;
     bool oinf;
     jac_to_affine(acc, ox, oy, oinf);
@@ -1833,6 +1867,201 @@ int cc_hash_to_g2(const uint8_t *msg, int mlen, const uint8_t *dst, int dlen,
   g2_store(out192, x, y, inf);
   return 0;
 }
+
+// --- native Pedersen VSS / DVSS (completes the secret_sharing rebuild
+// target, SURVEY.md §2.2; reference surface keygen.rs:74-205) ---------------
+
+// Uniform random Fr (canonical LE) from OS entropy: 64 bytes of getrandom
+// reduced mod r (bias 2^-256) — the native face of the reference's
+// FieldElement::random (rand crate, Cargo.toml:10). Returns 0 on success.
+int cc_fr_random(uint8_t *out32) {
+  uint8_t buf[64];
+  size_t got = 0;
+  while (got < sizeof buf) {
+    ssize_t r = getrandom(buf + got, sizeof buf - got, 0);
+    if (r <= 0) return 1;
+    got += (size_t)r;
+  }
+  fr_init();
+  u64 limbs[4];
+  bytes_mod(buf, sizeof buf, RL, 4, limbs);
+  for (int i = 0; i < 4; i++)
+    for (int j = 0; j < 8; j++)
+      out32[i * 8 + j] = (uint8_t)(limbs[i] >> (8 * j));
+  return 0;
+}
+
+// Pedersen deal from caller-supplied polynomial coefficients (a0 first,
+// 32B LE each): commitments comm[j] = g^{f_j} h^{g_j} (const-time — the
+// coefficients are secret), shares s_i = F(i), t_i = G(i) for i = 1..n.
+// Split from the RNG so differential tests vs the Python spec (sss.py)
+// can drive both paths from one coefficient set. Mirrors
+// PedersenVSS::deal (keygen.rs:93-94).
+void cc_pedersen_deal_from_coeffs(int t, int n, const uint8_t *g96,
+                                  const uint8_t *h96, const uint8_t *fc,
+                                  const uint8_t *gc, uint8_t *out_comms,
+                                  uint8_t *out_s, uint8_t *out_t) {
+  ccbls_init();
+  pedersen_ct_rows(g96, h96, fc, gc, t, out_comms);
+  for (int i = 1; i <= n; i++) {
+    cc_fr_poly_eval(fc, t, (uint32_t)i, out_s + (size_t)(i - 1) * 32);
+    cc_fr_poly_eval(gc, t, (uint32_t)i, out_t + (size_t)(i - 1) * 32);
+  }
+}
+
+// Full native deal: fresh random coefficients + the above. Returns 0 on
+// success (nonzero: entropy failure).
+int cc_pedersen_deal(int t, int n, const uint8_t *g96, const uint8_t *h96,
+                     uint8_t *out_fc, uint8_t *out_gc, uint8_t *out_comms,
+                     uint8_t *out_s, uint8_t *out_t) {
+  for (int j = 0; j < t; j++) {
+    if (cc_fr_random(out_fc + (size_t)j * 32)) return 1;
+    if (cc_fr_random(out_gc + (size_t)j * 32)) return 1;
+  }
+  cc_pedersen_deal_from_coeffs(t, n, g96, h96, out_fc, out_gc, out_comms,
+                               out_s, out_t);
+  return 0;
+}
+
+// verify_share (keygen.rs:334-351): g^s h^t == prod_j comm[j]^{id^j}.
+// The share side runs const-time (it is the holder's secret); the
+// commitment side is public -> var-time. Returns 1 valid, 0 invalid.
+int cc_pedersen_verify_share(int t, uint32_t share_id, const uint8_t *s32,
+                             const uint8_t *t32, const uint8_t *comms,
+                             const uint8_t *g96, const uint8_t *h96) {
+  ccbls_init();
+  uint8_t lhs[96];
+  pedersen_ct_rows(g96, h96, s32, t32, 1, lhs);
+  fr_init();
+  Fr e = FR_ONE, idf = fr_from_u64(share_id);
+  Jac<Fp> acc = jac_inf<Fp>();
+  for (int j = 0; j < t; j++) {
+    Fp cx, cy;
+    bool cinf = g1_load(comms + (size_t)j * 96, cx, cy);
+    if (!cinf) {
+      uint8_t eb[32];
+      fr_to_le(e, eb);
+      acc = jac_add(acc, g1_smul(cx, cy, scalar_load(eb)));
+    }
+    e = fr_mul(e, idf);
+  }
+  Fp rx, ry;
+  bool rinf;
+  jac_to_affine(acc, rx, ry, rinf);
+  uint8_t rhs[96];
+  g1_store(rhs, rx, ry, rinf);
+  return memcmp(lhs, rhs, 96) == 0 ? 1 : 0;
+}
+
+// --- DVSS participant state machine (keygen.rs:124-205): deal own secret,
+// receive + verify pairwise shares, additively combine. Opaque handle ABI;
+// the protocol driver (who sends what to whom) stays host-side, exactly as
+// the reference keeps it in share_secret_for_testing (keygen.rs:126-165).
+
+struct CcDvss {
+  uint32_t id;
+  int t, n;
+  uint8_t g[96], h[96];
+  std::vector<uint8_t> fc, gc;              // own poly coeffs (secret)
+  std::vector<uint8_t> comms;               // own coefficient commitments
+  std::vector<uint8_t> s_shares, t_shares;  // dealt shares for ids 1..n
+  std::vector<char> have;                   // indexed by from_id
+  std::vector<uint8_t> recv_s, recv_t;      // indexed by from_id
+  std::vector<uint8_t> recv_comms;          // from_id-indexed, t*96 each
+  int received;
+};
+
+CcDvss *cc_dvss_new(uint32_t id, int t, int n, const uint8_t *g96,
+                    const uint8_t *h96) {
+  if (t <= 0 || n < t || id < 1 || (int)id > n) return nullptr;
+  CcDvss *p = new CcDvss();
+  p->id = id;
+  p->t = t;
+  p->n = n;
+  memcpy(p->g, g96, 96);
+  memcpy(p->h, h96, 96);
+  p->fc.resize((size_t)t * 32);
+  p->gc.resize((size_t)t * 32);
+  p->comms.resize((size_t)t * 96);
+  p->s_shares.resize((size_t)n * 32);
+  p->t_shares.resize((size_t)n * 32);
+  p->have.assign(n + 1, 0);
+  p->recv_s.assign((size_t)(n + 1) * 32, 0);
+  p->recv_t.assign((size_t)(n + 1) * 32, 0);
+  p->recv_comms.assign((size_t)(n + 1) * t * 96, 0);
+  p->received = 0;
+  if (cc_pedersen_deal(t, n, g96, h96, p->fc.data(), p->gc.data(),
+                       p->comms.data(), p->s_shares.data(),
+                       p->t_shares.data())) {
+    delete p;
+    return nullptr;
+  }
+  return p;
+}
+
+// Own deal outputs (what gets broadcast / sent pairwise): commitments
+// (t*96) and the (s, t) share addressed to each participant id (n*32 each).
+void cc_dvss_deal(const CcDvss *p, uint8_t *out_comms, uint8_t *out_s,
+                  uint8_t *out_t) {
+  memcpy(out_comms, p->comms.data(), p->comms.size());
+  memcpy(out_s, p->s_shares.data(), p->s_shares.size());
+  memcpy(out_t, p->t_shares.data(), p->t_shares.size());
+}
+
+// Receive + verify from_id's share addressed to us. 0 = ok; 1 = own id;
+// 2 = out of range; 3 = duplicate; 4 = share fails verification.
+int cc_dvss_receive(CcDvss *p, uint32_t from_id, const uint8_t *comms,
+                    const uint8_t *s32, const uint8_t *t32) {
+  if (from_id == p->id) return 1;
+  if (from_id < 1 || (int)from_id > p->n) return 2;
+  if (p->have[from_id]) return 3;
+  if (cc_pedersen_verify_share(p->t, p->id, s32, t32, comms, p->g, p->h) != 1)
+    return 4;
+  memcpy(p->recv_s.data() + (size_t)from_id * 32, s32, 32);
+  memcpy(p->recv_t.data() + (size_t)from_id * 32, t32, 32);
+  memcpy(p->recv_comms.data() + (size_t)from_id * p->t * 96, comms,
+         (size_t)p->t * 96);
+  p->have[from_id] = 1;
+  p->received++;
+  return 0;
+}
+
+// Finalize: own + received shares summed into this participant's share of
+// the distributed secret (keygen.rs:161-163); coefficient commitments
+// combined point-wise for later share checks. 0 = ok; 1 = missing shares.
+int cc_dvss_finalize(CcDvss *p, uint8_t *out_s32, uint8_t *out_t32,
+                     uint8_t *out_final_comms) {
+  if (p->received != p->n - 1) return 1;
+  fr_init();
+  Fr sa = fr_from_le(p->s_shares.data() + (size_t)(p->id - 1) * 32);
+  Fr ta = fr_from_le(p->t_shares.data() + (size_t)(p->id - 1) * 32);
+  for (int f = 1; f <= p->n; f++) {
+    if (!p->have[f]) continue;
+    sa = fr_add(sa, fr_from_le(p->recv_s.data() + (size_t)f * 32));
+    ta = fr_add(ta, fr_from_le(p->recv_t.data() + (size_t)f * 32));
+  }
+  fr_to_le(sa, out_s32);
+  fr_to_le(ta, out_t32);
+  for (int j = 0; j < p->t; j++) {
+    Fp x, y;
+    bool inf = g1_load(p->comms.data() + (size_t)j * 96, x, y);
+    Jac<Fp> acc = inf ? jac_inf<Fp>() : Jac<Fp>{x, y, FP_ONE};
+    for (int f = 1; f <= p->n; f++) {
+      if (!p->have[f]) continue;
+      Fp cx, cy;
+      bool cinf =
+          g1_load(p->recv_comms.data() + ((size_t)f * p->t + j) * 96, cx, cy);
+      acc = jac_add_affine(acc, cx, cy, cinf);
+    }
+    Fp ox, oy;
+    bool oinf;
+    jac_to_affine(acc, ox, oy, oinf);
+    g1_store(out_final_comms + (size_t)j * 96, ox, oy, oinf);
+  }
+  return 0;
+}
+
+void cc_dvss_free(CcDvss *p) { delete p; }
 
 int cc_selftest() {
   ccbls_init();
