@@ -7,10 +7,13 @@ readback), monotonic counters (verifies, batches, transfer bytes), and a
 `snapshot()` the bench harness embeds in its JSON output so TPU claims are
 auditable.
 
-Zero-cost when unused: plain dicts, no background threads, no deps. JAX
-device-side profiling composes with this via `jax.profiler` /
-`jax.named_scope` (the kernels in tpu/backend.py are the natural scopes);
-host-side phases are what these timers capture.
+Zero-cost when unused: plain dicts, no background threads, no deps.
+Device-side profiling is separate: the hot kernels in tpu/backend.py carry
+`jax.named_scope` annotations (comb_msm, grouped_tables /
+grouped_gather_fold / grouped_horner, miller_two_pairs / grouped_miller,
+affine_norm, final_exp) and `BENCH_PROFILE=1 python bench.py` writes a
+`jax.profiler` trace broken down by those scopes; host-side phases are
+what these timers capture.
 """
 
 import time

@@ -84,23 +84,39 @@ def batch_show(sigs, vk, params, messages_list, revealed_msg_indices,
     blindings = [[rand_fr() for _ in range(1 + len(hidden))] for _ in range(B)]
 
     # sigma'_1 = sigma_1^r ; sigma'_2 = (sigma_2 + t sigma_1)^r
-    #          = sigma_2^r + sigma_1^{t r}  — 1- and 2-term distinct MSMs
-    sigma1p = msm_sig_distinct(
-        [[s.sigma_1] for s in sigs], [[r] for r in rs]
-    )
-    sigma2p = msm_sig_distinct(
-        [[s.sigma_2, s.sigma_1] for s in sigs],
-        [[r, t * r % R] for r, t in zip(rs, ts)],
-    )
+    #          = sigma_2^r + sigma_1^{t r}  — ONE fused distinct MSM: the
+    # sigma'_1 rows pad to the sigma'_2 width (k = 2) and stack to [2B, 2],
+    # one dispatch + readback instead of two (VERDICT r3 item 5)
+    sig_rows = [[s.sigma_1, None] for s in sigs] + [
+        [s.sigma_2, s.sigma_1] for s in sigs
+    ]
+    scal_rows = [[r, 0] for r in rs] + [
+        [r, t * r % R] for r, t in zip(rs, ts)
+    ]
+    sig_out = msm_sig_distinct(sig_rows, scal_rows)
+    sigma1p, sigma2p = sig_out[:B], sig_out[B:]
     # J = g_tilde^t * prod_hidden Y_j^{m_j} and the Schnorr commitment
-    # t-point over the SAME shared bases — two comb MSMs
+    # t-point over the SAME shared bases — two comb MSMs, fused into one
+    # device program when the backend supports multi-MSM jobs
     bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
     secrets_rows = [
         [t] + [msgs[i] for i in hidden]
         for t, msgs in zip(ts, messages_list)
     ]
-    Js = msm_other_shared(bases, [[s % R for s in row] for row in secrets_rows])
-    comms = msm_other_shared(bases, blindings)
+    many = getattr(
+        backend,
+        "msm_g2_shared_many" if ctx.name == "G1" else "msm_g1_shared_many",
+        None,
+    )
+    jobs = [
+        (bases, [[s % R for s in row] for row in secrets_rows]),
+        (bases, blindings),
+    ]
+    if many is not None:
+        Js, comms = many(jobs)
+    else:
+        Js = msm_other_shared(*jobs[0])
+        comms = msm_other_shared(*jobs[1])
 
     # Fiat-Shamir + responses, host-side (cheap field/hash work)
     bases_bytes = b"".join(ctx.other_to_bytes(b) for b in bases)

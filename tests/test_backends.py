@@ -462,6 +462,138 @@ class TestNativeSss:
         assert sss.reconstruct_secret(3, sub) == secret
 
 
+class TestNativePedersenVss:
+    """Native Pedersen VSS/DVSS (keygen.rs:74-205 surface) vs the Python
+    sss module — same coefficients must produce bit-identical commitments
+    and shares, and the two participant implementations must interoperate."""
+
+    def _gens(self):
+        from coconut_tpu import sss
+
+        return sss.PedersenVSS.gens(b"native-vss-test")
+
+    def test_deal_from_coeffs_matches_python(self):
+        from coconut_tpu import native, sss
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        g, h = self._gens()
+        t, n = 3, 5
+        fc = [rng.randrange(R) for _ in range(t)]
+        gc = [rng.randrange(R) for _ in range(t)]
+        comms, ss_, ts = native.pedersen_deal_from_coeffs(t, n, g, h, fc, gc)
+        want_comms = {
+            j: g1.add(g1.mul(g, fc[j]), g1.mul(h, gc[j])) for j in range(t)
+        }
+        assert comms == want_comms
+        assert ss_ == {i: sss.poly_eval(fc, i) for i in range(1, n + 1)}
+        assert ts == {i: sss.poly_eval(gc, i) for i in range(1, n + 1)}
+
+    def test_verify_share_cross_implementation(self):
+        from coconut_tpu import native, sss
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        g, h = self._gens()
+        t, n = 3, 5
+        # native deal verified by BOTH verifiers; a tampered share fails both
+        sec, blind, comms, s_sh, t_sh = native.pedersen_deal(t, n, g, h)
+        for i in range(1, n + 1):
+            share = (s_sh[i], t_sh[i])
+            assert native.pedersen_verify_share(t, i, share, comms, g, h)
+            assert sss.PedersenVSS.verify_share(t, i, share, comms, g, h)
+        bad = ((s_sh[2] + 1) % R, t_sh[2])
+        assert not native.pedersen_verify_share(t, 2, bad, comms, g, h)
+        assert not sss.PedersenVSS.verify_share(t, 2, bad, comms, g, h)
+        # python deal verified by the native verifier
+        psec, pblind, pcomms, ps_sh, pt_sh = sss.PedersenVSS.deal(t, n, g, h)
+        for i in (1, 4):
+            assert native.pedersen_verify_share(
+                t, i, (ps_sh[i], pt_sh[i]), pcomms, g, h
+            )
+        # dealt secret is reconstructable from any t shares
+        assert sss.reconstruct_secret(
+            t, {i: s_sh[i] for i in (1, 3, 5)}
+        ) == sec
+
+    def test_dvss_native_matches_python_protocol(self):
+        from coconut_tpu import native, sss
+        from coconut_tpu.errors import GeneralError
+
+        if not native.available():
+            pytest.skip("native library unavailable")
+        g, h = self._gens()
+        t, n = 2, 4
+        ps = native.share_secret_dvss(t, n, g, h)
+        # the distributed secret (sum of the per-participant dealt secrets)
+        # reconstructs from any t final shares — same oracle the reference
+        # asserts in check_reconstructed_keys (keygen.rs:231-297)
+        shares = {p.id: p.secret_share for p in ps}
+        for sub in ({1, 2}, {2, 4}, {1, 3}):
+            got = sss.reconstruct_secret(t, {i: shares[i] for i in sub})
+            first = sss.reconstruct_secret(t, dict(list(shares.items())[:t]))
+            assert got == first
+        # all participants agree on the combined coefficient commitments
+        for p in ps[1:]:
+            assert p.final_comm_coeffs == ps[0].final_comm_coeffs
+        # combined commitments verify each final share (python-side check)
+        for p in ps:
+            assert sss.PedersenVSS.verify_share(
+                t,
+                p.id,
+                (p.secret_share, p.t_secret_share),
+                p.final_comm_coeffs,
+                g,
+                h,
+            )
+        # a native participant interoperates inside the python protocol
+        py = sss.PedersenDVSSParticipant(1, t, 3, g, h)
+        nat = native.DvssParticipant(2, t, 3, g, h)
+        py3 = sss.PedersenDVSSParticipant(3, t, 3, g, h)
+        group = [py, nat, py3]
+        for recv in group:
+            for sender in group:
+                if sender.id == recv.id:
+                    continue
+                recv.received_share(
+                    sender.id,
+                    sender.comm_coeffs,
+                    (sender.s_shares[recv.id], sender.t_shares[recv.id]),
+                    t,
+                    3,
+                    g,
+                    h,
+                )
+        for p in group:
+            p.compute_final_comm_coeffs_and_shares(t, 3, g, h)
+        assert nat.final_comm_coeffs == py.final_comm_coeffs
+        rec_a = sss.reconstruct_secret(
+            t, {1: py.secret_share, 2: nat.secret_share}
+        )
+        rec_b = sss.reconstruct_secret(
+            t, {2: nat.secret_share, 3: py3.secret_share}
+        )
+        assert rec_a == rec_b
+        # duplicate + self-share rejection on the native state machine
+        with pytest.raises(GeneralError):
+            nat.received_share(
+                1, py.comm_coeffs, (py.s_shares[2], py.t_shares[2])
+            )
+        with pytest.raises(GeneralError):
+            nat.received_share(
+                2, nat.comm_coeffs, (nat.s_shares[2], nat.t_shares[2])
+            )
+        # a corrupted pairwise share is detected (the malicious-dealer
+        # fault-tolerance story, README.md:52-68)
+        fresh = native.DvssParticipant(3, t, 3, g, h)
+        with pytest.raises(GeneralError):
+            fresh.received_share(
+                1,
+                py.comm_coeffs,
+                ((py.s_shares[3] + 1) % R, py.t_shares[3]),
+            )
+
+
 class TestConstTimeMsm:
     """The native masked-lookup MSM (ct=True): complete-formula path must be
     bit-identical to the var-time path on adversarial digit patterns, and
@@ -518,6 +650,29 @@ class TestConstTimeMsm:
         ct.msm_g1_shared(bases, maxes)
         tm = time.perf_counter() - t0
         assert max(tz, tm) / min(tz, tm) < 1.5, (tz, tm)
+
+
+class TestGlv:
+    """GLV endomorphism constants and decomposition (tpu/glv.py) vs the
+    spec ops: phi's eigenvalue, exactness of the Euclidean split, and the
+    reassembled scalar mul."""
+
+    def test_phi_eigenvalue_and_decomposition(self):
+        from coconut_tpu.tpu import glv
+
+        for _ in range(5):
+            pt = g1.mul(G1_GEN, rng.randrange(1, R))
+            assert glv.phi(pt) == g1.mul(pt, glv.LAMBDA)
+        assert glv.phi(None) is None
+        for k in (0, 1, glv.LAMBDA - 1, glv.LAMBDA, R - 1,
+                  rng.randrange(R), rng.randrange(R)):
+            k1, k2 = glv.decompose(k)
+            assert 0 <= k1 < 1 << 128 and 0 <= k2 < 1 << 128
+            assert (k1 + k2 * glv.LAMBDA) % R == k % R
+            pt = g1.mul(G1_GEN, 0xBEEF)
+            assert g1.mul(pt, k) == g1.add(
+                g1.mul(pt, k1), g1.mul(glv.phi(pt), k2)
+            )
 
 
 def test_python_backend_is_default_registry():
