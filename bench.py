@@ -21,7 +21,7 @@ Also measured (BASELINE.md configs):
   config 5: short streamed run through verify_stream               [BENCH_STREAM=1]
 
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
-Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 3),
+Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
 BENCH_BACKEND (jax|python), BENCH_PERCRED/BENCH_SHOW/BENCH_ISSUE (default 1),
 BENCH_STREAM (default 1 — config 5 is driver-captured), BENCH_COMBINED
 (default 0).
@@ -61,7 +61,10 @@ def bench_python(batch, ge, params, vk, sigs, msgs_list, extras):
 
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "1024"))
-    reps = int(os.environ.get("BENCH_REPS", "3"))
+    # best-of-5: the tunneled chip shows 30-60% run-to-run variance under
+    # contention (measured 0.40-0.65 s for the identical compiled grouped
+    # program); more reps make the best-of timing robust to that noise
+    reps = int(os.environ.get("BENCH_REPS", "5"))
     backend_name = os.environ.get("BENCH_BACKEND", "jax")
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -262,7 +265,11 @@ def bench_jax(batch, reps, ge, params, sk, vk, sigs, msgs_list, extras):
             batch_prepare_blind_sign,
         )
 
-        n_req = min(batch, int(os.environ.get("BENCH_ISSUE_N", "256")))
+        # full-batch issuance: the small-distinct-MSM programs underfill
+        # the VPU below ~1k lanes (256 -> 1024 lanes measured 157 -> 393
+        # prepare/s, 658 -> 1262 blind-sign/s), so the honest batch shape
+        # is the same 1024 the verify configs use
+        n_req = min(batch, int(os.environ.get("BENCH_ISSUE_N", "1024")))
         # fixture (keygen) and first-call compile timed SEPARATELY so the
         # artifact shows which part of issuance is slow (VERDICT r3 weak 8)
         t0 = time.time()

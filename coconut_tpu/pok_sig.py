@@ -84,20 +84,15 @@ def batch_show(sigs, vk, params, messages_list, revealed_msg_indices,
     blindings = [[rand_fr() for _ in range(1 + len(hidden))] for _ in range(B)]
 
     # sigma'_1 = sigma_1^r ; sigma'_2 = (sigma_2 + t sigma_1)^r
-    #          = sigma_2^r + sigma_1^{t r}  — ONE fused distinct MSM: the
-    # sigma'_1 rows pad to the sigma'_2 width (k = 2) and stack to [2B, 2],
-    # one dispatch + readback instead of two (VERDICT r3 item 5)
-    sig_rows = [[s.sigma_1, None] for s in sigs] + [
-        [s.sigma_2, s.sigma_1] for s in sigs
-    ]
-    scal_rows = [[r, 0] for r in rs] + [
-        [r, t * r % R] for r, t in zip(rs, ts)
-    ]
-    sig_out = msm_sig_distinct(sig_rows, scal_rows)
-    sigma1p, sigma2p = sig_out[:B], sig_out[B:]
+    #          = sigma_2^r + sigma_1^{t r}
+    s2_rows = [[s.sigma_2, s.sigma_1] for s in sigs]
+    s2_scal = [[r, t * r % R] for r, t in zip(rs, ts)]
     # J = g_tilde^t * prod_hidden Y_j^{m_j} and the Schnorr commitment
     # t-point over the SAME shared bases — two comb MSMs, fused into one
-    # device program when the backend supports multi-MSM jobs
+    # device program when the backend supports multi-MSM jobs. The sigma
+    # MSM and the J/commitment MSMs are independent, so with an
+    # async-capable backend both programs are dispatched before either is
+    # decoded (the sigma decode then overlaps the comb program).
     bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
     secrets_rows = [
         [t] + [msgs[i] for i in hidden]
@@ -108,15 +103,48 @@ def batch_show(sigs, vk, params, messages_list, revealed_msg_indices,
         "msm_g2_shared_many" if ctx.name == "G1" else "msm_g1_shared_many",
         None,
     )
+    many_async = getattr(
+        backend,
+        "msm_g2_shared_many_async"
+        if ctx.name == "G1"
+        else "msm_g1_shared_many_async",
+        None,
+    )
+    distinct_async = getattr(
+        backend,
+        "msm_g1_distinct_async"
+        if ctx.name == "G1"
+        else "msm_g2_distinct_async",
+        None,
+    )
     jobs = [
         (bases, [[s % R for s in row] for row in secrets_rows]),
         (bases, blindings),
     ]
-    if many is not None:
-        Js, comms = many(jobs)
+    if many_async is not None and distinct_async is not None:
+        # ONE fused distinct MSM for the sigma pair: the sigma'_1 rows pad
+        # to the sigma'_2 width (k = 2) and stack to [2B, 2] — a single
+        # dispatch + readback (VERDICT r3 item 5). Only the single-dispatch
+        # device backend gains from the stacking; the per-row fallbacks
+        # below skip the dummy column.
+        sig_handle = distinct_async(
+            [[s.sigma_1, None] for s in sigs] + s2_rows,
+            [[r, 0] for r in rs] + s2_scal,
+        )
+        many_handle = many_async(jobs)
+        sig_out = backend.msm_distinct_wait(sig_handle)
+        Js, comms = backend.msm_shared_many_wait(many_handle)
+        sigma1p, sigma2p = sig_out[:B], sig_out[B:]
     else:
-        Js = msm_other_shared(*jobs[0])
-        comms = msm_other_shared(*jobs[1])
+        sigma1p = msm_sig_distinct(
+            [[s.sigma_1] for s in sigs], [[r] for r in rs]
+        )
+        sigma2p = msm_sig_distinct(s2_rows, s2_scal)
+        if many is not None:
+            Js, comms = many(jobs)
+        else:
+            Js = msm_other_shared(*jobs[0])
+            comms = msm_other_shared(*jobs[1])
 
     # Fiat-Shamir + responses, host-side (cheap field/hash work)
     bases_bytes = b"".join(ctx.other_to_bytes(b) for b in bases)

@@ -570,22 +570,45 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
     ks = [[rand_fr() for _ in range(count_hidden)] for _ in range(B)]
     flat_k = [[k] for row in ks for k in row]
 
-    # the three shared-base MSMs of the phase (commitments, ElGamal g^k,
-    # ElGamal pk^k) run as ONE device program when the backend fuses
-    # multi-MSM jobs (JaxBackend.msm_g*_shared_many) — the round-3 prepare
-    # path paid three dispatch+readback round trips (VERDICT r3 item 4)
-    many = getattr(
-        backend,
-        "msm_g1_shared_many" if ctx.name == "G1" else "msm_g2_shared_many",
-        None,
-    )
     if count_hidden == 0:
         commitments = msm_shared(commit_bases, commit_rows)
         return [
             (SignatureRequest(k, c, []), [r])
             for k, c, r in zip(known_lists, commitments, rs)
         ]
-    if many is not None:
+
+    # The phase's device work is three shared-base comb MSM jobs
+    # (commitments, ElGamal g^k, ElGamal pk^k) plus one distinct-base MSM
+    # (h_i^{m_ij}) that DEPENDS on the commitments through the per-request
+    # hash h = H(commitment || known) (the reference's anti-malleability
+    # generator, signature.rs:194-206). With an async-capable backend the
+    # schedule hides the host hash loop and result decodes behind device
+    # execution: dispatch commitments, dispatch the (independent) ElGamal
+    # jobs behind them, block only on commitments, hash while the device
+    # runs the ElGamal program, dispatch h^m, then decode the ElGamal
+    # results while h^m executes (VERDICT r3 item 4).
+    g1 = ctx.name == "G1"
+    many_async = getattr(
+        backend,
+        "msm_g1_shared_many_async" if g1 else "msm_g2_shared_many_async",
+        None,
+    )
+    many = getattr(
+        backend, "msm_g1_shared_many" if g1 else "msm_g2_shared_many", None
+    )
+    distinct_async = getattr(
+        backend,
+        "msm_g1_distinct_async" if g1 else "msm_g2_distinct_async",
+        None,
+    )
+    elg_handle = None
+    if many_async is not None:
+        commit_handle = many_async([(commit_bases, commit_rows)])
+        elg_handle = many_async(
+            [([params.g], flat_k), ([elgamal_pk], flat_k)]
+        )
+        (commitments,) = backend.msm_shared_many_wait(commit_handle)
+    elif many is not None:
         commitments, gk, pkk = many(
             [
                 (commit_bases, commit_rows),
@@ -599,7 +622,8 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
         pkk = msm_shared([elgamal_pk], flat_k)
 
     # per-request anti-malleability generator h (hash of public data);
-    # the native core is ~2 orders faster than the Python spec here
+    # the native core is ~2 orders faster than the Python spec here.
+    # On the async path this loop overlaps the ElGamal device program.
     from . import native as _native
 
     hash_native = ctx.name == "G1" and _native.available()
@@ -614,10 +638,18 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
 
     # the per-request h^{m_ij} terms need h, which needs the commitment
     # hash — an unavoidable host round trip between the two programs
-    hm = msm_distinct(
-        [[h] for h in hs for _ in range(count_hidden)],
-        [[m % R] for msgs in messages_list for m in msgs[:count_hidden]],
-    )
+    hm_points = [[h] for h in hs for _ in range(count_hidden)]
+    hm_scalars = [
+        [m % R] for msgs in messages_list for m in msgs[:count_hidden]
+    ]
+    if elg_handle is not None and distinct_async is not None:
+        hm_handle = distinct_async(hm_points, hm_scalars)
+        gk, pkk = backend.msm_shared_many_wait(elg_handle)
+        hm = backend.msm_distinct_wait(hm_handle)
+    else:
+        if elg_handle is not None:
+            gk, pkk = backend.msm_shared_many_wait(elg_handle)
+        hm = msm_distinct(hm_points, hm_scalars)
     out = []
     for i, (msgs, known, c, h, r) in enumerate(
         zip(messages_list, known_lists, commitments, hs, rs)
@@ -670,31 +702,48 @@ def batch_blind_sign(sig_requests, sigkey, params, backend=None):
                 len(req.ciphertexts) + len(req.known_messages),
             )
     hs = [req.get_h(ctx) for req in sig_requests]
-    # ONE fused distinct-base MSM for both c_tilde_1 and c_tilde_2: the
-    # c_tilde_1 rows (k = hidden) are padded with an identity base / zero
-    # scalar to the c_tilde_2 width (k = hidden + 1) and stacked into a
-    # [2B, hidden+1] batch — one device dispatch + readback instead of two
-    # (the round-3 issuance path was dispatch-bound, VERDICT r3 item 4)
-    points, scalars = [], []
-    for req in sig_requests:
-        points.append([a for a, _ in req.ciphertexts] + [None])
-        scalars.append(list(sigkey.y[:hidden_count]) + [0])
+    g1 = ctx.name == "G1"
+    msm = backend.msm_g1_distinct if g1 else backend.msm_g2_distinct
+    c2_points, c2_scalars = [], []
     for req, h in zip(sig_requests, hs):
         exp = sigkey.x
         for i, m in enumerate(req.known_messages):
             exp = (exp + sigkey.y[hidden_count + i] * m) % R
-        points.append([b for _, b in req.ciphertexts] + [h])
-        scalars.append(list(sigkey.y[:hidden_count]) + [exp])
-    msm = (
-        backend.msm_g1_distinct
-        if ctx.name == "G1"
-        else backend.msm_g2_distinct
-    )
-    out = msm(points, scalars)
+        c2_points.append([b for _, b in req.ciphertexts] + [h])
+        c2_scalars.append(list(sigkey.y[:hidden_count]) + [exp])
     B = len(sig_requests)
+    fused = getattr(
+        backend,
+        "msm_g1_distinct_async" if g1 else "msm_g2_distinct_async",
+        None,
+    )
+    if fused is not None:
+        # ONE fused distinct-base MSM for both c_tilde_1 and c_tilde_2: the
+        # c_tilde_1 rows (k = hidden) pad with an identity base / zero
+        # scalar to the c_tilde_2 width (k = hidden + 1) and stack into a
+        # [2B, hidden+1] batch — one device dispatch + readback instead of
+        # two (the round-3 issuance path was dispatch-bound, VERDICT r3
+        # item 4). Only the single-dispatch device backend gains from the
+        # stacking; per-row backends would pay the dummy column for nothing.
+        points = [
+            [a for a, _ in req.ciphertexts] + [None] for req in sig_requests
+        ] + c2_points
+        scalars = [
+            list(sigkey.y[:hidden_count]) + [0] for _ in sig_requests
+        ] + c2_scalars
+        out = backend.msm_distinct_wait(fused(points, scalars))
+        c1s, c2s = out[:B], out[B:]
+    elif hidden_count == 0:
+        c1s = [None] * B  # no ciphertexts -> c_tilde_1 is the identity
+        c2s = msm(c2_points, c2_scalars)
+    else:
+        c1s = msm(
+            [[a for a, _ in req.ciphertexts] for req in sig_requests],
+            [list(sigkey.y[:hidden_count])] * B,
+        )
+        c2s = msm(c2_points, c2_scalars)
     return [
-        BlindSignature(h, (c1, c2))
-        for h, c1, c2 in zip(hs, out[:B], out[B:])
+        BlindSignature(h, (c1, c2)) for h, c1, c2 in zip(hs, c1s, c2s)
     ]
 
 

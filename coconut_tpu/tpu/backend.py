@@ -81,9 +81,21 @@ def _comb_window_default():
         return 6
 
 
-_C_WINDOW = _comb_window_default()
-_C_NWIN = -(-255 // _C_WINDOW)  # 32 (8-bit) / 43 (6-bit)
-_C_ENTRIES = (1 << (_C_WINDOW - 1)) + 1  # 129 / 33
+_C_SCHED = None
+
+
+def _comb_schedule():
+    """(window, nwin, entries) for the shared-base comb — 32/129 at 8-bit,
+    43/33 at 6-bit. Chosen LAZILY on first use: `jax.default_backend()`
+    initializes the platform client, and doing that at import time would
+    both break callers that configure the platform after importing this
+    module (multi-process TPU init ordering) and freeze the window choice
+    before their config lands."""
+    global _C_SCHED
+    if _C_SCHED is None:
+        w = _comb_window_default()
+        _C_SCHED = (w, -(-255 // w), (1 << (w - 1)) + 1)
+    return _C_SCHED
 
 # GLV on distinct-base G1 MSMs (see _msm_distinct). Kill switch for callers
 # that feed curve points outside the r-order subgroup.
@@ -121,7 +133,8 @@ def _build_tables(spec_ops, bases, entries=16):
 @functools.partial(jax.jit, static_argnums=(0,))
 def _comb_build_kernel(field_is_fp2, tables_e):
     fl = cv.FP2 if field_is_fp2 else cv.FP
-    return cv.build_comb_tables(fl, tables_e, _C_NWIN, _C_WINDOW)
+    window, nwin, _ = _comb_schedule()
+    return cv.build_comb_tables(fl, tables_e, nwin, window)
 
 
 # (is_fp2, base points) -> device comb tables. Bases are spec tuples of
@@ -136,7 +149,7 @@ def _comb_tables(spec_ops, is_fp2, bases):
     key = (is_fp2, tuple(bases))
     wt = _COMB_CACHE.get(key)
     if wt is None:
-        t_e = _build_tables(spec_ops, bases, entries=_C_ENTRIES)
+        t_e = _build_tables(spec_ops, bases, entries=_comb_schedule()[2])
         wt = _comb_build_kernel(is_fp2, t_e)
         if len(_COMB_CACHE) > 64:  # ad-hoc base sets must not pile up
             _COMB_CACHE.clear()
@@ -161,7 +174,8 @@ def _signed_digits(scalars_batch, nwin=_SIGNED_NWIN, window=5):
 
 
 def _comb_digits(scalars_batch):
-    return _signed_digits(scalars_batch, nwin=_C_NWIN, window=_C_WINDOW)
+    window, nwin, _ = _comb_schedule()
+    return _signed_digits(scalars_batch, nwin=nwin, window=window)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -624,15 +638,22 @@ class JaxBackend(CurveBackend):
     def msm_g2_shared(self, bases, scalars_batch):
         return self._msm_shared(_sg2, True, bases, scalars_batch)
 
-    def _msm_shared_many(self, spec_ops, is_fp2, jobs):
-        """jobs: [(bases, scalars_batch)] -> list of per-job result lists,
-        all jobs fused into one device program (one dispatch/readback)."""
+    def _msm_shared_many_dispatch(self, spec_ops, is_fp2, jobs):
+        """Encode + launch the fused multi-MSM program; returns the device
+        output handle WITHOUT blocking (jax dispatch is async). Pair with
+        `msm_shared_many_wait` — protocol drivers overlap host work (e.g.
+        the prepare step's hash-to-group loop, signature.rs:194-206 shape)
+        with device execution this way."""
         operands = []
         for bases, scalars_batch in jobs:
             wt = _comb_tables(spec_ops, is_fp2, bases)
             mag, sgn = _comb_digits(scalars_batch)
             operands.append((wt, mag, sgn))
-        outs = _msm_shared_many_kernel(is_fp2, tuple(operands))
+        return _msm_shared_many_kernel(is_fp2, tuple(operands))
+
+    @staticmethod
+    def msm_shared_many_wait(outs):
+        """Block on a `_dispatch` handle and decode to spec points."""
         results = []
         for x, y, inf in outs:
             xs = tw.decode_batch(x)
@@ -643,11 +664,24 @@ class JaxBackend(CurveBackend):
             )
         return results
 
+    def _msm_shared_many(self, spec_ops, is_fp2, jobs):
+        """jobs: [(bases, scalars_batch)] -> list of per-job result lists,
+        all jobs fused into one device program (one dispatch/readback)."""
+        return self.msm_shared_many_wait(
+            self._msm_shared_many_dispatch(spec_ops, is_fp2, jobs)
+        )
+
     def msm_g1_shared_many(self, jobs):
         return self._msm_shared_many(_sg1, False, jobs)
 
     def msm_g2_shared_many(self, jobs):
         return self._msm_shared_many(_sg2, True, jobs)
+
+    def msm_g1_shared_many_async(self, jobs):
+        return self._msm_shared_many_dispatch(_sg1, False, jobs)
+
+    def msm_g2_shared_many_async(self, jobs):
+        return self._msm_shared_many_dispatch(_sg2, True, jobs)
 
     def _msm_distinct(self, is_fp2, points_batch, scalars_batch):
         B = len(points_batch)
@@ -691,18 +725,31 @@ class JaxBackend(CurveBackend):
         x, y = jax.tree_util.tree_map(reshape, (x, y))
         inf = inf.reshape(B, k)
         mag, sgn = _signed_digits(scalars_batch, nwin=nwin)
-        ax, ay, ainf = _msm_distinct_affine_kernel(
-            is_fp2, x, y, inf, mag, sgn
-        )
+        return _msm_distinct_affine_kernel(is_fp2, x, y, inf, mag, sgn)
+
+    @staticmethod
+    def msm_distinct_wait(handle):
+        """Block on a `_distinct` dispatch handle and decode to spec points."""
+        ax, ay, ainf = handle
         xs = tw.decode_batch(ax)
         ys = tw.decode_batch(ay)
         infs = np.asarray(ainf)
         return [None if i else (xv, yv) for xv, yv, i in zip(xs, ys, infs)]
 
     def msm_g1_distinct(self, points_batch, scalars_batch):
-        return self._msm_distinct(False, points_batch, scalars_batch)
+        return self.msm_distinct_wait(
+            self._msm_distinct(False, points_batch, scalars_batch)
+        )
 
     def msm_g2_distinct(self, points_batch, scalars_batch):
+        return self.msm_distinct_wait(
+            self._msm_distinct(True, points_batch, scalars_batch)
+        )
+
+    def msm_g1_distinct_async(self, points_batch, scalars_batch):
+        return self._msm_distinct(False, points_batch, scalars_batch)
+
+    def msm_g2_distinct_async(self, points_batch, scalars_batch):
         return self._msm_distinct(True, points_batch, scalars_batch)
 
     def pairing_product_is_one(self, pairs_batch):
