@@ -23,8 +23,8 @@ Also measured (BASELINE.md configs):
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
 BENCH_BACKEND (jax|python), BENCH_PERCRED/BENCH_SHOW/BENCH_ISSUE (default 1),
-BENCH_STREAM (default 1 — config 5 is driver-captured), BENCH_COMBINED
-(default 0).
+BENCH_STREAM (default 1 — config 5 is driver-captured), BENCH_STREAM_BATCHES
+(default 8), BENCH_ISSUE_N (default 1024), BENCH_COMBINED (default 0).
 """
 
 import json
@@ -310,7 +310,7 @@ def bench_jax(batch, reps, ge, params, sk, vk, sigs, msgs_list, extras):
 
         from coconut_tpu.stream import verify_stream
 
-        n_batches = int(os.environ.get("BENCH_STREAM_BATCHES", "4"))
+        n_batches = int(os.environ.get("BENCH_STREAM_BATCHES", "8"))
         t0 = time.time()
         state = verify_stream(
             lambda i: (sigs, msgs_list),

@@ -157,6 +157,7 @@ def verify_stream(
     mode="per_credential",
     pipeline=True,
     mesh=None,
+    pipeline_depth=3,
 ):
     """Verify `n_batches` batches from `source(i) -> (sigs, messages_list)`.
 
@@ -165,7 +166,15 @@ def verify_stream(
     with the mode's result type (bools list / one bool) — the hook for
     collecting results or metrics. `pipeline=True` overlaps host encode of
     batch i+1 with device execution of batch i when the backend supports
-    async dispatch. `mesh` dp-shards the grouped mode over a jax Mesh
+    async dispatch; `pipeline_depth` batches stay in flight before the
+    oldest is settled, keeping the device queue non-empty across the
+    result-readback round trip (on the tunneled chip the RTT is
+    ~0.2 s/batch, comparable to the grouped program's own 0.21 s device
+    time, so depth 1 leaves the device idle half the time: measured
+    2,520 -> 4,416 -> ~4,700 creds/s at depths 1/3/4 against the ~4,875/s
+    device-time ceiling). Checkpoint lag is bounded by the depth: a crash
+    re-runs at most `pipeline_depth` batches (at-least-once delivery, same
+    as depth 1). `mesh` dp-shards the grouped mode over a jax Mesh
     (multi-chip config 5)."""
     from .backend import get_backend
 
@@ -173,6 +182,8 @@ def verify_stream(
         backend = get_backend(backend or "python")
     dispatch, record, is_async = _dispatchers(backend, mode, mesh=mesh)
     pipeline = pipeline and is_async  # sync backends: settle immediately
+    if pipeline_depth < 1:
+        raise ValueError("pipeline_depth must be >= 1")
     state = StreamState(state_path)
 
     def settle(idx, fin, n):
@@ -186,16 +197,16 @@ def verify_stream(
         state.next_batch = idx + 1
         state.save()
 
-    pending = None  # (index, finalizer, batch_size)
+    pending = []  # [(index, finalizer, batch_size)] oldest first
     for i in range(state.next_batch, n_batches):
         sigs, messages_list = source(i)
         fin = dispatch(sigs, messages_list, vk, params)
         if not pipeline:
             settle(i, fin, len(sigs))
             continue
-        if pending is not None:
-            settle(*pending)
-        pending = (i, fin, len(sigs))
-    if pending is not None:
-        settle(*pending)
+        pending.append((i, fin, len(sigs)))
+        if len(pending) >= pipeline_depth:
+            settle(*pending.pop(0))
+    for p in pending:
+        settle(*p)
     return state

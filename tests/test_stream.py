@@ -95,14 +95,7 @@ def test_stream_grouped_mode_accounting():
     assert seen == [(0, True), (1, False), (2, True)]
 
 
-def test_stream_pipeline_overlaps_dispatch_and_settle():
-    """With an async-capable backend, batch i+1 is DISPATCHED before batch
-    i's result is read back (the double-buffer overlap, SURVEY §2.3
-    pipeline row), and results still settle in order."""
-    rng, params, sk, vk = _setup()
-    source = _source_factory(rng, params, sk)
-    events = []
-
+def _events_backend(events):
     class AsyncBk:
         def batch_verify_async(self, s, m, v, p):
             i = len([e for e in events if e[0] == "dispatch"])
@@ -114,7 +107,20 @@ def test_stream_pipeline_overlaps_dispatch_and_settle():
 
             return fin
 
-    state = verify_stream(source, 3, vk, params, AsyncBk())
+    return AsyncBk()
+
+
+def test_stream_pipeline_overlaps_dispatch_and_settle():
+    """With an async-capable backend, `pipeline_depth` batches are
+    DISPATCHED before the oldest result is read back (the in-flight queue
+    that hides the device round trip, SURVEY §2.3 pipeline row), and
+    results still settle in order."""
+    rng, params, sk, vk = _setup()
+    source = _source_factory(rng, params, sk)
+    events = []
+    state = verify_stream(
+        source, 3, vk, params, _events_backend(events), pipeline_depth=2
+    )
     assert state.verified == 3 * BATCH
     assert events == [
         ("dispatch", 0),
@@ -124,6 +130,24 @@ def test_stream_pipeline_overlaps_dispatch_and_settle():
         ("settle", 1),
         ("settle", 2),
     ]
+
+
+def test_stream_pipeline_default_depth_keeps_queue_full():
+    """Default depth (3): all of the first 3 batches dispatch before any
+    settles; settling stays in order and checkpoint lag is bounded."""
+    rng, params, sk, vk = _setup()
+    source = _source_factory(rng, params, sk)
+    events = []
+    state = verify_stream(source, 5, vk, params, _events_backend(events))
+    assert state.verified == 5 * BATCH
+    assert events[:3] == [("dispatch", i) for i in range(3)]
+    settles = [i for kind, i in events if kind == "settle"]
+    assert settles == list(range(5))
+    # every settle of batch i happens only after dispatch of batch i+depth-1
+    for i in range(5):
+        s_at = events.index(("settle", i))
+        d_count = len([e for e in events[:s_at] if e[0] == "dispatch"])
+        assert d_count >= min(i + 3, 5)
 
 
 def test_stream_resume_from_checkpoint(tmp_path):
