@@ -114,13 +114,15 @@ def batch_show(sigs, vk, params, messages_list, revealed_msg_indices,
         # dispatch + readback (VERDICT r3 item 5). Only the single-dispatch
         # device backend gains from the stacking; the per-row fallbacks
         # below skip the dummy column.
-        sig_handle = distinct_api[0](
+        distinct_dispatch, distinct_wait = distinct_api
+        many_dispatch, many_wait = many_api
+        sig_handle = distinct_dispatch(
             [[s.sigma_1, None] for s in sigs] + s2_rows,
             [[r, 0] for r in rs] + s2_scal,
         )
-        many_handle = many_api[0](jobs)
-        sig_out = distinct_api[1](sig_handle)
-        Js, comms = many_api[1](many_handle)
+        many_handle = many_dispatch(jobs)
+        sig_out = distinct_wait(sig_handle)
+        Js, comms = many_wait(many_handle)
         sigma1p, sigma2p = sig_out[:B], sig_out[B:]
     else:
         sigma1p = msm_sig_distinct(

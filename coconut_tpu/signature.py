@@ -636,9 +636,10 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
         [m % R] for msgs in messages_list for m in msgs[:count_hidden]
     ]
     if elg_handle is not None and distinct_api is not None:
-        hm_handle = distinct_api[0](hm_points, hm_scalars)
+        distinct_dispatch, distinct_wait = distinct_api
+        hm_handle = distinct_dispatch(hm_points, hm_scalars)
         gk, pkk = many_wait(elg_handle)
-        hm = distinct_api[1](hm_handle)
+        hm = distinct_wait(hm_handle)
     else:
         if elg_handle is not None:
             gk, pkk = many_wait(elg_handle)
@@ -722,7 +723,8 @@ def batch_blind_sign(sig_requests, sigkey, params, backend=None):
         scalars = [
             list(sigkey.y[:hidden_count]) + [0] for _ in sig_requests
         ] + c2_scalars
-        out = fused[1](fused[0](points, scalars))
+        fused_dispatch, fused_wait = fused
+        out = fused_wait(fused_dispatch(points, scalars))
         c1s, c2s = out[:B], out[B:]
     elif hidden_count == 0:
         c1s = [None] * B  # no ciphertexts -> c_tilde_1 is the identity

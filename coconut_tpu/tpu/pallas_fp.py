@@ -89,6 +89,63 @@ def _ext(t, extra):
 
 
 _VPU = os.environ.get("COCONUT_PALLAS_VPU", "1") == "1"
+# One level of Karatsuba on the FULL 52-limb products (the t = a*b and
+# w = m*p steps): 3x 26-limb schoolbooks (2,028 lane-mults) replace the
+# 52x52 outer product (2,704), and the three short add-trees are cheaper
+# than the two full-width half-trees. Exactness survives: normalized
+# inputs are |limbs| <= 132, so the (x0+x1)(y0+y1) middle product's
+# coefficients are <= 26*264^2 < 2^21 and every assembled coefficient is
+# <= ~3.7M < 2^23 — still an exact f32 integer, so results stay
+# bit-identical (f32 addition of exact integers is order-independent).
+# The downstream 3-pass carry extractions absorb the ~4x larger
+# coefficient bound: pass-1 residual <= 128 + round(7.3M/256) ~ 28.5k,
+# pass 2 <= 239, pass 3 <= 129 <= 132 (the NORMALIZED class bound).
+# COCONUT_PALLAS_KARATSUBA=0 falls back to the single outer product.
+_KARATSUBA = os.environ.get("COCONUT_PALLAS_KARATSUBA", "1") == "1"
+_HALF = NLIMBS // 2  # 26
+
+
+def _tree(terms):  # pairwise tree: log depth for VPU ILP
+    while len(terms) > 1:
+        nxt = [terms[k] + terms[k + 1] for k in range(0, len(terms) - 1, 2)]
+        if len(terms) % 2:
+            nxt.append(terms[-1])
+        terms = nxt
+    return terms[0]
+
+
+def _school_comb(x, y, n, out_len):
+    """n-limb VPU comb schoolbook: shift-align the [n, n, TN] outer
+    product's rows and tree-sum them into 2n-1 coefficients. Row i
+    contributes to coefficients [i, i+n): rows split into a low half
+    t[0:n) and a high half t[n:2n-1) so no term pads to the full height.
+    out_len < 2n-1 truncates AFTER the sum (dropped terms belong to
+    limbs >= n and must not alias into the kept ones)."""
+    tn = x.shape[1]
+    outer = x[:, None, :] * y[None, :, :]  # [n, n, TN]
+    lo_terms, hi_terms = [], []
+    for i in range(n):
+        row = outer[i]
+        if i == 0:
+            lo_terms.append(row)
+            continue
+        lo_terms.append(
+            jnp.concatenate(
+                [jnp.zeros((i, tn), x.dtype), row[: n - i]], axis=0
+            )
+        )
+        hi_terms.append(
+            jnp.concatenate(
+                [row[n - i :], jnp.zeros((n - 1 - i, tn), x.dtype)]
+                if i < n - 1
+                else [row[n - i :]],
+                axis=0,
+            )
+        )
+    if out_len <= n:  # REDC's m-step: the high half is discarded
+        return _tree(lo_terms)[:out_len]
+    t = jnp.concatenate([_tree(lo_terms), _tree(hi_terms)], axis=0)
+    return t[:out_len]
 
 
 def _mul_kernel(a_ref, b_ref, band_ref, np_ref, p_ref, out_ref):
@@ -96,53 +153,22 @@ def _mul_kernel(a_ref, b_ref, band_ref, np_ref, p_ref, out_ref):
     b = _norm(b_ref[:], 2)
 
     def school_vpu(x, y, out_len):
-        """Comb schoolbook on the VPU: shift-align the outer product's
-        rows and tree-sum them. Every coefficient is a sum of <= 52
-        products <= 132^2 — exact f32, no byte planes, no matmul.
-        out_len < 103 truncates AFTER the sum (dropped terms belong to
-        limbs >= 52 and must not alias into the kept ones)."""
+        if not (_KARATSUBA and out_len == _OUT2):
+            return _school_comb(x, y, NLIMBS, out_len)
+        # full product via one Karatsuba level (see _KARATSUBA note)
         tn = x.shape[1]
-        outer = x[:, None, :] * y[None, :, :]  # [52, 52, TN]
-
-        def tree(terms):  # pairwise tree: log depth for VPU ILP
-            while len(terms) > 1:
-                nxt = [
-                    terms[k] + terms[k + 1]
-                    for k in range(0, len(terms) - 1, 2)
-                ]
-                if len(terms) % 2:
-                    nxt.append(terms[-1])
-                terms = nxt
-            return terms[0]
-
-        # Row i of the outer product contributes to coefficients
-        # [i, i+52): rows [0:52-i) of the low half t[0:52) and rows
-        # [0:i) of the high half t[52:103). Summing the two halves
-        # separately avoids padding every term to the full 103 rows
-        # (52x103 -> ~2x52x52 lane-adds).
-        lo_terms, hi_terms = [], []
-        for i in range(NLIMBS):
-            row = outer[i]
-            if i == 0:
-                lo_terms.append(row)
-                continue
-            lo_terms.append(
-                jnp.concatenate(
-                    [jnp.zeros((i, tn), x.dtype), row[: NLIMBS - i]], axis=0
-                )
-            )
-            hi_terms.append(
-                jnp.concatenate(
-                    [row[NLIMBS - i :], jnp.zeros((NLIMBS - 1 - i, tn), x.dtype)]
-                    if i < NLIMBS - 1
-                    else [row[NLIMBS - i :]],
-                    axis=0,
-                )
-            )
-        if out_len <= NLIMBS:  # REDC's m-step: the high half is discarded
-            return tree(lo_terms)[:out_len]
-        t = jnp.concatenate([tree(lo_terms), tree(hi_terms)], axis=0)
-        return t[:out_len]
+        x0, x1 = x[:_HALF], x[_HALF:]
+        y0, y1 = y[:_HALF], y[_HALF:]
+        z0 = _school_comb(x0, y0, _HALF, 2 * _HALF - 1)  # [51] coeffs 0..50
+        z2 = _school_comb(x1, y1, _HALF, 2 * _HALF - 1)  # -> offset 52
+        mid = _school_comb(x0 + x1, y0 + y1, _HALF, 2 * _HALF - 1)
+        z1 = mid - z0 - z2  # -> offset 26
+        zpad = lambda k: jnp.zeros((k, tn), x.dtype)
+        return (
+            jnp.concatenate([z0, zpad(_OUT2 - 51)], axis=0)
+            + jnp.concatenate([zpad(_HALF), z1, zpad(_OUT2 - _HALF - 51)], axis=0)
+            + jnp.concatenate([zpad(2 * _HALF), z2], axis=0)
+        )
 
     def school(x, y, out_len):
         if _VPU:
