@@ -121,6 +121,32 @@ def bench_jax(batch, reps, ge, params, sk, vk, sigs, msgs_list, extras):
     extras["device"] = str(jax.devices()[0])
     be = JaxBackend()
 
+    # Pallas Montgomery-mul spot check ON THE CHIP: 256 random products
+    # must decode to (x*y) mod p exactly. The fused kernels would catch a
+    # mul regression only as wrong verify bits; this names the culprit.
+    from coconut_tpu.ops.fields import P as _P
+    from coconut_tpu.tpu import limbs as _limbs
+    from coconut_tpu.tpu import pallas_fp as _pfp
+
+    if _pfp.enabled():
+        import random as _random
+
+        _rng = _random.Random(0xF00D)
+        _xs = [_rng.randrange(_P) for _ in range(256)]
+        _ys = [_rng.randrange(_P) for _ in range(256)]
+        _out = _limbs.fp_decode_batch(
+            np.asarray(
+                jax.jit(_pfp.mul)(
+                    jax.numpy.asarray(_limbs.fp_encode_batch(_xs)),
+                    jax.numpy.asarray(_limbs.fp_encode_batch(_ys)),
+                )
+            )
+        )
+        assert _out == [x * y % _P for x, y in zip(_xs, _ys)], (
+            "pallas fp.mul product mismatch"
+        )
+        extras["pallas_mul_exact"] = True
+
     # --- headline: attribute-grouped combined batch verify -----------------
     t0 = time.time()
     ok = be.batch_verify_grouped(sigs, msgs_list, vk, params)
@@ -327,6 +353,28 @@ def bench_jax(batch, reps, ge, params, sk, vk, sigs, msgs_list, extras):
         extras["stream_creds_per_sec"] = round(n_batches * batch / dt, 2)
         extras["stream_batches"] = n_batches
         extras["stream_mode"] = "grouped"
+
+        if os.environ.get("BENCH_PERCRED", "1") == "1":
+            # sustained PER-CREDENTIAL rate (one bit per credential, the
+            # reference's Signature::verify verdict semantics): the same
+            # pipelined stream with the fused per-credential program. The
+            # program is already compiled by the percred section above
+            # (same shapes), so this costs only the run time.
+            t0 = time.time()
+            state = verify_stream(
+                lambda i: (sigs, msgs_list),
+                n_batches,
+                vk,
+                params,
+                be,
+                state_path=os.path.join(tempfile.mkdtemp(), "stream.json"),
+                mode="per_credential",
+            )
+            dt = time.time() - t0
+            assert state.verified == n_batches * batch and state.failed == 0
+            extras["percred_stream_per_sec"] = round(
+                n_batches * batch / dt, 2
+            )
 
     return value
 
