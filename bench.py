@@ -337,44 +337,42 @@ def bench_jax(batch, reps, ge, params, sk, vk, sigs, msgs_list, extras):
         from coconut_tpu.stream import verify_stream
 
         n_batches = int(os.environ.get("BENCH_STREAM_BATCHES", "8"))
-        t0 = time.time()
-        state = verify_stream(
-            lambda i: (sigs, msgs_list),
-            n_batches,
-            vk,
-            params,
-            be,
-            state_path=os.path.join(tempfile.mkdtemp(), "stream.json"),
-            mode="grouped",  # ONE bool per batch — honest batch accounting
-        )
-        dt = time.time() - t0
-        assert state.batches_ok == n_batches and state.batches_failed == 0
-        assert state.verified == n_batches * batch
-        extras["stream_creds_per_sec"] = round(n_batches * batch / dt, 2)
-        extras["stream_batches"] = n_batches
-        extras["stream_mode"] = "grouped"
+        with tempfile.TemporaryDirectory() as tmpdir:
 
-        if os.environ.get("BENCH_PERCRED", "1") == "1":
-            # sustained PER-CREDENTIAL rate (one bit per credential, the
-            # reference's Signature::verify verdict semantics): the same
-            # pipelined stream with the fused per-credential program. The
-            # program is already compiled by the percred section above
-            # (same shapes), so this costs only the run time.
-            t0 = time.time()
-            state = verify_stream(
-                lambda i: (sigs, msgs_list),
-                n_batches,
-                vk,
-                params,
-                be,
-                state_path=os.path.join(tempfile.mkdtemp(), "stream.json"),
-                mode="per_credential",
-            )
-            dt = time.time() - t0
-            assert state.verified == n_batches * batch and state.failed == 0
-            extras["percred_stream_per_sec"] = round(
-                n_batches * batch / dt, 2
-            )
+            def stream(mode, name):
+                t0 = time.time()
+                state = verify_stream(
+                    lambda i: (sigs, msgs_list),
+                    n_batches,
+                    vk,
+                    params,
+                    be,
+                    state_path=os.path.join(tmpdir, name),
+                    mode=mode,
+                )
+                return state, time.time() - t0
+
+            # grouped: ONE bool per batch — honest batch accounting
+            state, dt = stream("grouped", "grouped.json")
+            assert state.batches_ok == n_batches and state.batches_failed == 0
+            assert state.verified == n_batches * batch
+            extras["stream_creds_per_sec"] = round(n_batches * batch / dt, 2)
+            extras["stream_batches"] = n_batches
+            extras["stream_mode"] = "grouped"
+
+            if os.environ.get("BENCH_PERCRED", "1") == "1":
+                # sustained PER-CREDENTIAL rate (one bit per credential,
+                # the reference's Signature::verify verdict semantics):
+                # the same pipelined stream with the fused per-credential
+                # program, which the percred section above already
+                # compiled (same shapes) — this costs only run time.
+                state, dt = stream("per_credential", "percred.json")
+                assert (
+                    state.verified == n_batches * batch and state.failed == 0
+                )
+                extras["percred_stream_per_sec"] = round(
+                    n_batches * batch / dt, 2
+                )
 
     return value
 

@@ -635,7 +635,23 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
     hm_scalars = [
         [m % R] for msgs in messages_list for m in msgs[:count_hidden]
     ]
-    if elg_handle is not None and distinct_api is not None:
+    offset_dispatch = getattr(
+        backend, "msm_%s_distinct_plus_offset_async" % grp, None
+    )
+    c2s = None
+    if (
+        elg_handle is not None
+        and offset_dispatch is not None
+        and distinct_api is not None
+    ):
+        # c2 = pk^k + h^m assembled ON DEVICE: the ElGamal program's pk^k
+        # output triple feeds the h^m MSM program as a per-lane offset
+        # (device-to-device), replacing the host decode of pk^k plus
+        # B*hidden host point-adds
+        c2_handle = offset_dispatch(hm_points, hm_scalars, elg_handle[1])
+        (gk,) = many_wait((elg_handle[0],))
+        c2s = distinct_api[1](c2_handle)
+    elif elg_handle is not None and distinct_api is not None:
         distinct_dispatch, distinct_wait = distinct_api
         hm_handle = distinct_dispatch(hm_points, hm_scalars)
         gk, pkk = many_wait(elg_handle)
@@ -651,7 +667,8 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
         cts = []
         for j in range(count_hidden):
             f = i * count_hidden + j
-            cts.append((gk[f], ops.add(pkk[f], hm[f])))
+            c2 = c2s[f] if c2s is not None else ops.add(pkk[f], hm[f])
+            cts.append((gk[f], c2))
         req = SignatureRequest(known, c, cts)
         req._h_cache = h
         out.append((req, [r] + ks[i]))

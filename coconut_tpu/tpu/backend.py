@@ -198,6 +198,21 @@ def _msm_distinct_affine_kernel(field_is_fp2, x, y, inf, mag, sgn):
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
+def _msm_distinct_plus_offset_kernel(
+    field_is_fp2, x, y, inf, mag, sgn, ox, oy, oinf
+):
+    """Distinct-base MSM with a per-lane affine offset added before the
+    affine conversion: affine(offset_i + sum_j s_ij * P_ij). The offset
+    is another device program's affine output triple, consumed
+    device-to-device — the prepare phase's c2 = pk^k + h^m assembly rides
+    here instead of decoding pk^k and adding ~2B points on the host."""
+    fl = cv.FP2 if field_is_fp2 else cv.FP
+    acc = cv.msm_distinct_signed(fl, x, y, inf, mag, sgn)
+    off = cv.affine_to_jacobian(fl, ox, oy, oinf)
+    return cv.to_affine(fl, cv.jadd(fl, acc, off))
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
 def _msm_shared_many_kernel(field_is_fp2, jobs):
     """Several independent shared-base comb MSMs in ONE XLA program: one
     dispatch + one readback for a whole protocol phase (the issuance
@@ -683,7 +698,9 @@ class JaxBackend(CurveBackend):
     def msm_g2_shared_many_async(self, jobs):
         return self._msm_shared_many_dispatch(_sg2, True, jobs)
 
-    def _msm_distinct(self, is_fp2, points_batch, scalars_batch):
+    def _encode_distinct(self, is_fp2, points_batch, scalars_batch):
+        """Shared encode for the distinct-MSM kernels: GLV split (G1),
+        limb encoding, signed-digit recode -> (x, y, inf, mag, sgn)."""
         B = len(points_batch)
         k = len(points_batch[0])
         if any(len(row) != k for row in points_batch):
@@ -725,7 +742,12 @@ class JaxBackend(CurveBackend):
         x, y = jax.tree_util.tree_map(reshape, (x, y))
         inf = inf.reshape(B, k)
         mag, sgn = _signed_digits(scalars_batch, nwin=nwin)
-        return _msm_distinct_affine_kernel(is_fp2, x, y, inf, mag, sgn)
+        return x, y, inf, mag, sgn
+
+    def _msm_distinct(self, is_fp2, points_batch, scalars_batch):
+        return _msm_distinct_affine_kernel(
+            is_fp2, *self._encode_distinct(is_fp2, points_batch, scalars_batch)
+        )
 
     @staticmethod
     def msm_distinct_wait(handle):
@@ -751,6 +773,36 @@ class JaxBackend(CurveBackend):
 
     def msm_g2_distinct_async(self, points_batch, scalars_batch):
         return self._msm_distinct(True, points_batch, scalars_batch)
+
+    def _msm_distinct_plus_offset(
+        self, is_fp2, points_batch, scalars_batch, offset_handle
+    ):
+        ox, oy, oinf = offset_handle
+        return _msm_distinct_plus_offset_kernel(
+            is_fp2,
+            *self._encode_distinct(is_fp2, points_batch, scalars_batch),
+            ox,
+            oy,
+            oinf,
+        )
+
+    def msm_g1_distinct_plus_offset_async(
+        self, points_batch, scalars_batch, offset_handle
+    ):
+        """affine(offset_i + MSM_i) with `offset_handle` an affine device
+        triple (x, y, inf) of shape [B] — e.g. one job's output from a
+        `msm_g*_shared_many_async` dispatch, consumed without a host
+        round trip. Settle with msm_distinct_wait."""
+        return self._msm_distinct_plus_offset(
+            False, points_batch, scalars_batch, offset_handle
+        )
+
+    def msm_g2_distinct_plus_offset_async(
+        self, points_batch, scalars_batch, offset_handle
+    ):
+        return self._msm_distinct_plus_offset(
+            True, points_batch, scalars_batch, offset_handle
+        )
 
     def pairing_product_is_one(self, pairs_batch):
         B = len(pairs_batch)
