@@ -161,6 +161,18 @@ def async_distinct_api(backend, group):
     )
 
 
+def async_distinct_plus_offset_api(backend, group):
+    """(dispatch, wait) for the optional offset-fused distinct MSM
+    (affine(offset_i + MSM_i), offset consumed device-to-device from a
+    shared-many job handle), or None — same unit-probe rationale: the
+    dispatch must come paired with the wait that decodes its handles."""
+    return _async_pair(
+        backend,
+        "msm_%s_distinct_plus_offset_async" % group,
+        "msm_distinct_wait",
+    )
+
+
 _REGISTRY = {}
 
 

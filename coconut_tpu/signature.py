@@ -635,22 +635,19 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
     hm_scalars = [
         [m % R] for msgs in messages_list for m in msgs[:count_hidden]
     ]
-    offset_dispatch = getattr(
-        backend, "msm_%s_distinct_plus_offset_async" % grp, None
-    )
+    from .backend import async_distinct_plus_offset_api
+
+    offset_api = async_distinct_plus_offset_api(backend, grp)
     c2s = None
-    if (
-        elg_handle is not None
-        and offset_dispatch is not None
-        and distinct_api is not None
-    ):
+    if elg_handle is not None and offset_api is not None:
         # c2 = pk^k + h^m assembled ON DEVICE: the ElGamal program's pk^k
         # output triple feeds the h^m MSM program as a per-lane offset
         # (device-to-device), replacing the host decode of pk^k plus
         # B*hidden host point-adds
+        offset_dispatch, offset_wait = offset_api
         c2_handle = offset_dispatch(hm_points, hm_scalars, elg_handle[1])
         (gk,) = many_wait((elg_handle[0],))
-        c2s = distinct_api[1](c2_handle)
+        c2s = offset_wait(c2_handle)
     elif elg_handle is not None and distinct_api is not None:
         distinct_dispatch, distinct_wait = distinct_api
         hm_handle = distinct_dispatch(hm_points, hm_scalars)

@@ -373,6 +373,30 @@ class TestBatchProver:
         for sig, msgs in zip(sigs, msgs_list):
             assert ps_verify(sig, msgs, vk, params)
 
+    def test_batch_prepare_blind_sign_g2_assignment(self):
+        """The SIGNATURES_IN_G2 prepare path through the jax backend: the
+        fused ElGamal/commitment programs and the offset-fused c2 kernel
+        run in Fp2 there (the reference tests both group assignments,
+        .travis.yml:8-9). Ciphertexts must decrypt to h^m exactly."""
+        pytest.importorskip("jax")
+        from coconut_tpu.elgamal import elgamal_decrypt, elgamal_keygen
+        from coconut_tpu.params import SIGNATURES_IN_G2, Params
+        from coconut_tpu.signature import batch_prepare_blind_sign
+
+        params = Params.new(3, b"backend-test-g2", ctx=SIGNATURES_IN_G2)
+        ops = params.ctx.sig
+        elg_sk, elg_pk = elgamal_keygen(ops, params.g)
+        msgs_list = [[rng.randrange(R) for _ in range(3)] for _ in range(2)]
+        out = batch_prepare_blind_sign(
+            msgs_list, 2, elg_pk, params, backend=get_backend("jax")
+        )
+        for (req, rand), msgs in zip(out, msgs_list):
+            h = req.get_h(params.ctx)
+            for j, (c1, c2) in enumerate(req.ciphertexts):
+                assert elgamal_decrypt(ops, c1, c2, elg_sk) == ops.mul(
+                    h, msgs[j] % R
+                )
+
 
 class TestBatchIssuance:
     """batch_blind_sign / batch_unblind vs the sequential per-request path
