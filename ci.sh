@@ -25,7 +25,17 @@ else
 fi
 
 echo "== driver probes =="
-python -c "import __graft_entry__" # imports compile-check the entry wiring
+# Compile (not just import) the flagship entry program and check its
+# bits, exactly as the driver's compile-check does. Budget ~5.5 min on
+# this host: the cost is dominated by Python tracing + host comb-table
+# build (the persistent cache only removes the XLA compile), so treat
+# this as the entry probe's expected wall time, not a cache miss.
+python -c "
+import __graft_entry__ as ge
+fn, a = ge.entry()
+import jax
+assert bool(jax.jit(fn)(*a).all())
+"
 # Run the multi-chip dryrun exactly as the driver does (8-device virtual CPU
 # mesh). tests/test_shard.py compiled these exact programs above, so this is
 # warm-seconds from the persistent cache — and it keeps the cache seeded so
