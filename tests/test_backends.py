@@ -402,30 +402,55 @@ class TestBatchIssuance:
     """batch_blind_sign / batch_unblind vs the sequential per-request path
     (BASELINE config 4; reference signature.rs:396-443)."""
 
-    def test_matches_sequential(self, backend, params, keypair):
+    @pytest.mark.parametrize(
+        "hidden,batch_prepare",
+        [(2, False), (0, True), (1, True), (MSG_COUNT, True)],
+    )
+    def test_matches_sequential(
+        self, backend, params, keypair, hidden, batch_prepare
+    ):
+        """Batched blind-sign/unblind parity with the sequential path
+        (signature.rs:124-207, 380-443), over the standard split
+        (hidden=2, sequentially-prepared requests) and the boundary
+        splits through the batched prepare: hidden=0 (no ciphertexts ->
+        c_tilde_1 is the identity, the unfused fallback's dedicated
+        branch), hidden=1, and all-hidden (no known messages in the h
+        derivation / c_tilde_2 exponent)."""
         from coconut_tpu.elgamal import elgamal_keygen
         from coconut_tpu.signature import (
             BlindSignature,
             SignatureRequest,
             batch_blind_sign,
+            batch_prepare_blind_sign,
             batch_unblind,
         )
 
         sk, vk = keypair
         elg_sk, elg_pk = elgamal_keygen(params.ctx.sig, params.g)
-        reqs, msgs_all = [], []
-        for _ in range(4):
-            msgs = [rng.randrange(R) for _ in range(MSG_COUNT)]
-            req, _ = SignatureRequest.new(msgs, 2, elg_pk, params)
-            reqs.append(req)
-            msgs_all.append(msgs)
+        msgs_list = [
+            [rng.randrange(R) for _ in range(MSG_COUNT)]
+            for _ in range(4 if not batch_prepare else 2)
+        ]
+        if batch_prepare:
+            out = batch_prepare_blind_sign(
+                msgs_list, hidden, elg_pk, params, backend=backend
+            )
+            reqs = [r for r, _ in out]
+        else:
+            reqs = [
+                SignatureRequest.new(m, hidden, elg_pk, params)[0]
+                for m in msgs_list
+            ]
+        for req in reqs:
+            assert len(req.ciphertexts) == hidden
+            assert len(req.known_messages) == MSG_COUNT - hidden
         got = batch_blind_sign(reqs, sk, params, backend=backend)
         want = [BlindSignature.new(r, sk, params) for r in reqs]
         assert [(b.h, b.blinded) for b in got] == [
             (b.h, b.blinded) for b in want
         ]
         sigs = batch_unblind(got, elg_sk, params.ctx, backend=backend)
-        for sig, msgs in zip(sigs, msgs_all):
+        for sig, msgs in zip(sigs, msgs_list):
             assert ps_verify(sig, msgs, vk, params)
 
 
