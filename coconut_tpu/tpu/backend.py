@@ -74,7 +74,17 @@ def _comb_window_default():
 
     w = _os.environ.get("COCONUT_COMB_WINDOW")
     if w:
-        return int(w)
+        w = int(w)
+        # signed window magnitudes ride in uint8 digits
+        # (limbs.fr_digits_signed_np): a w-bit signed digit reaches
+        # 2^(w-1), so w=9 would wrap 256 -> 0 and return WRONG verify
+        # bits (observed; the bench asserts catch it). Fail loudly.
+        if not 1 <= w <= 8:
+            raise ValueError(
+                "COCONUT_COMB_WINDOW=%d unsupported: signed digit "
+                "magnitudes are uint8, so the window is capped at 8" % w
+            )
+        return w
     try:
         return 8 if jax.default_backend() == "tpu" else 6
     except Exception:  # pragma: no cover - backend init failure
