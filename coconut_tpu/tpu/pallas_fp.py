@@ -89,19 +89,30 @@ def _ext(t, extra):
 
 
 _VPU = os.environ.get("COCONUT_PALLAS_VPU", "1") == "1"
-# One level of Karatsuba on the FULL 52-limb products (the t = a*b and
-# w = m*p steps): 3x 26-limb schoolbooks (2,028 lane-mults) replace the
-# 52x52 outer product (2,704), and the three short add-trees are cheaper
-# than the two full-width half-trees. Exactness survives: normalized
-# inputs are |limbs| <= 132, so the (x0+x1)(y0+y1) middle product's
-# coefficients are <= 26*264^2 < 2^21 and every assembled coefficient is
-# <= ~3.7M < 2^23 — still an exact f32 integer, so results stay
-# bit-identical (f32 addition of exact integers is order-independent).
-# The downstream 3-pass carry extractions absorb the ~4x larger
-# coefficient bound: pass-1 residual <= 128 + round(7.3M/256) ~ 28.5k,
-# pass 2 <= 239, pass 3 <= 129 <= 132 (the NORMALIZED class bound).
-# COCONUT_PALLAS_KARATSUBA=0 falls back to the single outer product.
-_KARATSUBA = os.environ.get("COCONUT_PALLAS_KARATSUBA", "1") == "1"
+# Karatsuba on the FULL 52-limb products (the t = a*b and w = m*p steps).
+# One level: 3x 26-limb schoolbooks (2,028 lane-mults) replace the 52x52
+# outer product (2,704). Two levels (the default): each 26-schoolbook
+# splits again into 3x 13-limb schoolbooks — 9x169 = 1,521 lane-mults —
+# at the cost of deeper add-trees.
+#
+# Exactness proof (every f32 add of exact integers < 2^24 is exact, and
+# the partial-sum ORDER below keeps every intermediate under 2^24):
+#   level-2 operands: normalized halves |v| <= 132, L1-mid operands
+#   (x0+x1) <= 264, their L2 halves' sums <= 528.
+#   13-limb product coeff <= 13*528^2 = 3.63M; L2 z1 = mid - z0 - z2:
+#   partial |mid - z0| <= 3.63M + 0.91M = 4.54M < 2^24.
+#   Assembled 26-product coeff (z0 + z1 + z2 overlap) for M-bounded
+#   operands <= 104*M^2: M=264 -> 7.25M < 2^24 (partials <= 6.35M).
+#   L1 z1 = mid26 - z0_26 - z2_26: partial <= 7.25M + 3.63M = 10.9M
+#   < 2^24. Final 103-coeff assembly partials <= 3.63M + 10.9M = 14.5M
+#   < 2^24 = 16.8M; the finished coefficient is the TRUE product
+#   coefficient <= 52*132^2 = 0.91M. The downstream 3-pass carry
+#   extractions absorb the larger intermediate bound: pass-1 residual
+#   <= 128 + round(14.5M/256) ~ 57k, pass 2 <= 128 + 224 = 352, pass 3
+#   <= 128 + 2 <= 132 (the NORMALIZED class bound, as in fp.py).
+# COCONUT_PALLAS_KARATSUBA: 0 = plain outer product, 1 = one level,
+# 2 = two levels (default).
+_KARATSUBA = int(os.environ.get("COCONUT_PALLAS_KARATSUBA", "2"))
 _HALF = NLIMBS // 2  # 26
 
 
@@ -148,31 +159,52 @@ def _school_comb(x, y, n, out_len):
     return t[:out_len]
 
 
+def _kara_full(x, y, n, levels):
+    """Full [2n-1] coefficient product of n-limb operands via `levels` of
+    Karatsuba recursion (0 = plain comb schoolbook). Requires n even at
+    every recursion step; assembly order matches the exactness proof in
+    the _KARATSUBA note (z0 + z1 first, then + z2)."""
+    if levels <= 0 or n % 2:
+        return _school_comb(x, y, n, 2 * n - 1)
+    tn = x.shape[1]
+    half = n // 2
+    x0, x1 = x[:half], x[half:]
+    y0, y1 = y[:half], y[half:]
+    z0 = _kara_full(x0, y0, half, levels - 1)  # [2*half-1] coeffs 0..
+    z2 = _kara_full(x1, y1, half, levels - 1)  # -> offset 2*half
+    mid = _kara_full(x0 + x1, y0 + y1, half, levels - 1)
+    z1 = mid - z0 - z2  # -> offset half
+    out_len = 2 * n - 1
+    zpad = lambda k: jnp.zeros((k, tn), x.dtype)
+    return (
+        jnp.concatenate([z0, zpad(out_len - (2 * half - 1))], axis=0)
+        + jnp.concatenate(
+            [zpad(half), z1, zpad(out_len - half - (2 * half - 1))], axis=0
+        )
+        + jnp.concatenate([zpad(2 * half), z2], axis=0)
+    )
+
+
+def _school_vpu(x, y, out_len, karatsuba=None):
+    """The kernel's full limb product: plain comb schoolbook, or
+    `karatsuba` levels of recursion on the full-width products (see the
+    _KARATSUBA note). Module-level (pure jnp on [limbs, lanes] arrays) so
+    CPU differential tests can execute the exact assembly the TPU kernel
+    runs."""
+    if karatsuba is None:
+        karatsuba = _KARATSUBA
+    if not (karatsuba and out_len == _OUT2):
+        return _school_comb(x, y, NLIMBS, out_len)
+    return _kara_full(x, y, NLIMBS, int(karatsuba))
+
+
 def _mul_kernel(a_ref, b_ref, band_ref, np_ref, p_ref, out_ref):
     a = _norm(a_ref[:], 2)  # [52, TN], |limbs| <= 132
     b = _norm(b_ref[:], 2)
 
-    def school_vpu(x, y, out_len):
-        if not (_KARATSUBA and out_len == _OUT2):
-            return _school_comb(x, y, NLIMBS, out_len)
-        # full product via one Karatsuba level (see _KARATSUBA note)
-        tn = x.shape[1]
-        x0, x1 = x[:_HALF], x[_HALF:]
-        y0, y1 = y[:_HALF], y[_HALF:]
-        z0 = _school_comb(x0, y0, _HALF, 2 * _HALF - 1)  # [51] coeffs 0..50
-        z2 = _school_comb(x1, y1, _HALF, 2 * _HALF - 1)  # -> offset 52
-        mid = _school_comb(x0 + x1, y0 + y1, _HALF, 2 * _HALF - 1)
-        z1 = mid - z0 - z2  # -> offset 26
-        zpad = lambda k: jnp.zeros((k, tn), x.dtype)
-        return (
-            jnp.concatenate([z0, zpad(_OUT2 - 51)], axis=0)
-            + jnp.concatenate([zpad(_HALF), z1, zpad(_OUT2 - _HALF - 51)], axis=0)
-            + jnp.concatenate([zpad(2 * _HALF), z2], axis=0)
-        )
-
     def school(x, y, out_len):
         if _VPU:
-            return school_vpu(x, y, out_len)
+            return _school_vpu(x, y, out_len)
         # outer[i, j, :] = x[i, :] * y[j, :] -> band-sum over i + j == k
         outer = x[:, None, :] * y[None, :, :]
         flat = outer.reshape(NLIMBS * NLIMBS, x.shape[1])
@@ -224,8 +256,10 @@ def _mul_kernel(a_ref, b_ref, band_ref, np_ref, p_ref, out_ref):
     out_ref[:] = _norm(hi, 3)
 
 
-def _mul_flat(at, bt, nblocks):
-    """at, bt: f32 [52, nblocks*TN] transposed operands -> [52, n] product."""
+def _mul_flat(at, bt, nblocks, interpret=False):
+    """at, bt: f32 [52, nblocks*TN] transposed operands -> [52, n] product.
+    interpret=True runs the kernel through the Pallas interpreter (any
+    backend) — the CPU differential-test hook for this TPU-only path."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -263,6 +297,7 @@ def _mul_flat(at, bt, nblocks):
         out_specs=pl.BlockSpec(
             (NLIMBS, TN), lambda i: (0, i), memory_space=pltpu.VMEM
         ),
+        interpret=interpret,
     )(
         at,
         bt,
@@ -290,10 +325,11 @@ def enabled():
     return _ENABLED
 
 
-def mul(a, b):
+def mul(a, b, interpret=False):
     """Drop-in fused replacement for fp.mul on TPU: same element classes,
     bit-identical results. Flattens leading dims, pads lanes to TN, runs
-    the transposed Pallas kernel, restores shape."""
+    the transposed Pallas kernel, restores shape. interpret=True executes
+    the kernel via the Pallas interpreter on any backend (tests only)."""
     shape = jnp.broadcast_shapes(a.shape, b.shape)
     a = jnp.broadcast_to(a, shape).reshape(-1, NLIMBS)
     b = jnp.broadcast_to(b, shape).reshape(-1, NLIMBS)
@@ -304,7 +340,7 @@ def mul(a, b):
         zpad = jnp.zeros((pad, NLIMBS), jnp.float32)
         a = jnp.concatenate([a, zpad], axis=0)
         b = jnp.concatenate([b, zpad], axis=0)
-    out = _mul_flat(a.T, b.T, nblocks).T
+    out = _mul_flat(a.T, b.T, nblocks, interpret=interpret).T
     if pad:
         out = out[:n]
     return out.reshape(shape)
