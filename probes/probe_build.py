@@ -1,0 +1,42 @@
+"""Probe: comb-table scan build correctness vs (window, k) on the chip."""
+import functools
+import random
+import sys
+
+import jax
+
+import coconut_tpu.tpu
+
+coconut_tpu.tpu.enable_compile_cache()
+from coconut_tpu.ops.curve import G1_GEN, g1
+from coconut_tpu.ops.fields import R
+from coconut_tpu.tpu import curve as cv, tower as tw
+from coconut_tpu.tpu.backend import _build_tables
+
+window = int(sys.argv[1])
+k = int(sys.argv[2])
+nwin = -(-255 // window)
+entries = (1 << (window - 1)) + 1
+rng = random.Random(7)
+bases = [g1.mul(G1_GEN, rng.randrange(1, R)) for _ in range(k)]
+t_e = _build_tables(g1, bases, entries=entries)
+wt = jax.jit(
+    functools.partial(cv.build_comb_tables, cv.FP, nwin=nwin, window=window)
+)(t_e)
+bad = 0
+checks = [(0, nwin - 1, 1), (k - 1, nwin - 1, entries - 1), (0, 0, 1),
+          (k - 1, 0, entries - 1), (k // 2, nwin // 2, entries // 2)]
+for (j, w, d) in checks:
+    sel = jax.tree_util.tree_map(lambda t: t[j, w, d], wt)
+    ax, ay, ainf = jax.jit(lambda p: cv.to_affine(cv.FP, p))(sel)
+    if d == 0:
+        got = None if bool(ainf) else "pt"
+        want = None
+    else:
+        got = (
+            tw.decode_batch(jax.tree_util.tree_map(lambda t: t[None], ax))[0],
+            tw.decode_batch(jax.tree_util.tree_map(lambda t: t[None], ay))[0],
+        )
+        want = g1.mul(bases[j], d * pow(1 << window, nwin - 1 - w, R) % R)
+    bad += got != want
+print("window=%d k=%d lanes=%d bad=%d" % (window, k, k * entries, bad))

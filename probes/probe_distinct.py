@@ -1,0 +1,24 @@
+"""probe_distinct.py <B> <k> [g2]: distinct-base MSM differential vs spec,
+ALL lanes checked — the issuance-shape scan (build_tables_device carries
+[B, k] lanes) at full width."""
+import random, sys, time
+import coconut_tpu.tpu
+coconut_tpu.tpu.enable_compile_cache()
+from coconut_tpu.ops.curve import G1_GEN, G2_GEN, g1, g2
+from coconut_tpu.ops.fields import R
+from coconut_tpu.tpu.backend import JaxBackend
+
+B = int(sys.argv[1]); k = int(sys.argv[2])
+grp = sys.argv[3] if len(sys.argv) > 3 else "g1"
+rng = random.Random(5)
+be = JaxBackend()
+ops, gen, fn = (
+    (g1, G1_GEN, be.msm_g1_distinct) if grp == "g1" else (g2, G2_GEN, be.msm_g2_distinct)
+)
+pts = [[ops.mul(gen, rng.randrange(1, R)) for _ in range(k)] for _ in range(B)]
+scal = [[rng.randrange(R) for _ in range(k)] for _ in range(B)]
+t0 = time.time()
+got = fn(pts, scal)
+t_run = time.time() - t0
+bad = [i for i, (row_p, row_s, g) in enumerate(zip(pts, scal, got)) if g != ops.msm(row_p, row_s)]
+print("%s distinct B=%d k=%d bad=%d %r run=%.1fs" % (grp, B, k, len(bad), bad[:10], t_run))

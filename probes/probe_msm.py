@@ -1,0 +1,30 @@
+"""Probe: full shared-comb MSM differential vs spec at the production base
+count (k=7), all lanes checked. Usage: python probe_msm.py <window> <B>"""
+import random
+import sys
+import time
+
+import coconut_tpu.tpu
+
+coconut_tpu.tpu.enable_compile_cache()
+from coconut_tpu.ops.curve import G2_GEN, g2
+from coconut_tpu.ops.fields import R
+from coconut_tpu.tpu.backend import JaxBackend
+
+B = int(sys.argv[2]) if len(sys.argv) > 2 else 16
+rng = random.Random(11)
+be = JaxBackend()
+bases = [g2.mul(G2_GEN, rng.randrange(1, R)) for _ in range(7)]
+scal = [[rng.randrange(R) for _ in range(7)] for _ in range(B)]
+scal[B // 2][3] = 0
+t0 = time.time()
+got = be.msm_g2_shared(bases, scal)
+t_build = time.time() - t0
+t0 = time.time()
+got = be.msm_g2_shared(bases, scal)
+t_warm = time.time() - t0
+bad = sum(g != g2.msm(bases, row) for row, g in zip(scal, got))
+print(
+    "window=%s k=7 B=%d bad=%d build=%.1fs warm=%.2fs"
+    % (sys.argv[1], B, bad, t_build, t_warm)
+)

@@ -1,0 +1,24 @@
+"""probe_msm2.py <window> <group:g1|g2> <B>: full comb MSM differential."""
+import random, sys, time
+import coconut_tpu.tpu
+coconut_tpu.tpu.enable_compile_cache()
+from coconut_tpu.ops.curve import G1_GEN, G2_GEN, g1, g2
+from coconut_tpu.ops.fields import R
+from coconut_tpu.tpu.backend import JaxBackend
+
+grp = sys.argv[2]
+B = int(sys.argv[3]) if len(sys.argv) > 3 else 16
+k = int(sys.argv[4]) if len(sys.argv) > 4 else 7
+rng = random.Random(11)
+be = JaxBackend()
+ops, gen, fn = (
+    (g1, G1_GEN, be.msm_g1_shared) if grp == "g1" else (g2, G2_GEN, be.msm_g2_shared)
+)
+bases = [ops.mul(gen, rng.randrange(1, R)) for _ in range(k)]
+scal = [[rng.randrange(R) for _ in range(k)] for _ in range(B)]
+scal[B // 2][min(3, k - 1)] = 0
+t0 = time.time()
+got = fn(bases, scal)
+t_build = time.time() - t0
+bad = sum(g != ops.msm(bases, row) for row, g in zip(scal, got))
+print("window=%s %s k=%d B=%d bad=%d build=%.1fs" % (sys.argv[1], grp, k, B, bad, t_build))

@@ -1,0 +1,32 @@
+"""probe_mul.py: ns/lane-mul of the Pallas Montgomery multiply, dependent
+chain, on the chip. Env: COCONUT_PALLAS_KARATSUBA levels."""
+import os, time
+import numpy as np
+import jax, jax.numpy as jnp
+import coconut_tpu.tpu
+coconut_tpu.tpu.enable_compile_cache()
+from coconut_tpu.ops.fields import P
+from coconut_tpu.tpu import fp
+from coconut_tpu.tpu.limbs import MONT_R, balanced_limbs_batch
+
+N = 8192
+CHAIN = 64
+rng = np.random.default_rng(1)
+vals = [int(x) % P for x in rng.integers(1, 2**63, size=N)]
+a = jnp.asarray(balanced_limbs_batch([v * MONT_R % P for v in vals]))
+b = jnp.asarray(balanced_limbs_batch([(v * 31 + 7) % P * MONT_R % P for v in vals]))
+
+@jax.jit
+def chain(a, b):
+    x = a
+    for _ in range(CHAIN):
+        x = fp.mul(x, b)
+    return x.sum()
+
+out = chain(a, b); out.block_until_ready()
+best = None
+for _ in range(5):
+    t0 = time.time(); _ = np.asarray(chain(a, b)); dt = time.time() - t0
+    best = dt if best is None else min(best, dt)
+print("levels=%s ns/lane-mul=%.1f (N=%d chain=%d best=%.4fs)" % (
+    os.environ.get("COCONUT_PALLAS_KARATSUBA", "2"), best / (N * CHAIN) * 1e9, N, CHAIN, best))

@@ -1,0 +1,30 @@
+"""probe_tbl.py <window> <k>: decode chunked G2 comb-table entries vs spec."""
+import random, sys
+import jax
+import coconut_tpu.tpu
+coconut_tpu.tpu.enable_compile_cache()
+from coconut_tpu.ops.curve import G2_GEN, g2
+from coconut_tpu.ops.fields import R
+from coconut_tpu.tpu.backend import _comb_tables, _comb_schedule
+from coconut_tpu.tpu import curve as cv, tower as tw
+
+k = int(sys.argv[2])
+rng = random.Random(11)
+window, nwin, entries = _comb_schedule()
+bases = [g2.mul(G2_GEN, rng.randrange(1, R)) for _ in range(k)]
+wt = _comb_tables(g2, True, bases)
+bad = []
+checks = []
+for j in range(k):
+    checks += [(j, nwin - 1, 1), (j, 0, 1), (j, nwin // 2, entries - 1)]
+for (j, w, d) in checks:
+    sel = jax.tree_util.tree_map(lambda t: t[j, w, d], wt)
+    ax, ay, ainf = jax.jit(lambda p: cv.to_affine(cv.FP2, p))(sel)
+    got = (
+        tw.decode_batch(jax.tree_util.tree_map(lambda t: t[None], ax))[0],
+        tw.decode_batch(jax.tree_util.tree_map(lambda t: t[None], ay))[0],
+    )
+    want = g2.mul(bases[j], d * pow(1 << window, nwin - 1 - w, R) % R)
+    if got != want:
+        bad.append((j, w, d))
+print("window=%d k=%d G2 table bad=%d %r" % (window, k, len(bad), bad[:8]))

@@ -839,3 +839,32 @@ class TestGroupedMsms:
             want = g1.msm(pts, row)
             got = None if gi[m] else (gx[m], gy[m])
             assert got == want
+
+
+class TestCombCacheLru:
+    """_COMB_CACHE eviction: least-recently-used, never wholesale."""
+
+    def test_lru_eviction_keeps_hot_entries(self, monkeypatch):
+        from coconut_tpu.tpu import backend as be
+
+        monkeypatch.setattr(be, "_COMB_CACHE", {})
+        monkeypatch.setattr(be, "_COMB_CACHE_MAX", 4)
+        builds = []
+        monkeypatch.setattr(be, "_build_tables", lambda *_a, **_k: None)
+        monkeypatch.setattr(
+            be, "_comb_build_kernel", lambda *_a: builds.append(1) or len(builds)
+        )
+
+        def tables(i):
+            return be._comb_tables(None, False, ((i, i),))
+
+        hot = tables(0)
+        for i in range(1, 4):
+            tables(i)  # fill: cache = {0, 1, 2, 3}
+        assert tables(0) == hot and len(builds) == 4  # hit refreshes recency
+        tables(4)  # evicts 1 (LRU), NOT the just-touched 0
+        assert tables(0) == hot and len(builds) == 5
+        tables(1)  # 1 was evicted: rebuild
+        assert len(builds) == 6
+        # the hot entry survived every eviction
+        assert ((False, ((0, 0),)) in be._COMB_CACHE)
