@@ -180,7 +180,10 @@ _BUILD_MAX_LANES = 1028
 
 
 def _comb_tables(spec_ops, is_fp2, bases):
-    key = (is_fp2, tuple(bases))
+    # the window is part of the key: a schedule change mid-process (tests
+    # monkeypatching _C_SCHED) must never serve tables built for another
+    # window
+    key = (_comb_schedule()[0], is_fp2, tuple(bases))
     wt = _COMB_CACHE.get(key)
     if wt is None:
         entries = _comb_schedule()[2]
@@ -235,38 +238,48 @@ def _comb_digits(scalars_batch):
 
 
 def _pack_pt(x, y):
-    """Halve the device->host result bytes: affine outputs are LAZY
-    combinations of normalized limbs — G1 coordinates come straight out
-    of fp.mul (|v| <= 132), but G2 coordinates are fp2_mul outputs, i.e.
-    2- and 3-term sums of normalized values (c1 = t2 - t0 - t1), so the
-    true bound is |v| <= 3*132 = 396. int16 still carries every case
-    losslessly at half the f32 width; int8 would NOT (the G2 bound is the
-    reason — do not tighten this). The axon tunnel reads back at only
-    2-8 MB/s with ~100 ms latency (BASELINE.md caveat), so result bytes —
-    not device FLOPs — are the wall-clock cost of every point-returning
-    program (profiled: the prepare-phase multi-MSM program is 0.08 s of
-    device compute inside a 1.5 s wall). fp_decode_batch consumes any
-    numeric dtype, and the f32->int16 cast of a small exact integer is
-    exact. COCONUT_DEBUG_PACK=1 asserts the limb bound on-device."""
+    """Compress the device->host result bytes 4.3x: affine outputs are
+    LAZY combinations of normalized limbs — G1 coordinates come straight
+    out of fp.mul (|v| <= 132, |value| < 0.66p), G2 coordinates are
+    fp2_mul outputs, i.e. 2- and 3-term sums of normalized values
+    (c1 = t2 - t0 - t1), so the bounds are |v| <= 396, |value| < 1.98p —
+    inside fp.pack_canon48's contract, which carry-propagates on device
+    to 48 exact base-256 digits of a canonical-width representative
+    (48 B/Fp vs 208 B of f32 limbs; the r4 int16 packing was 104 B). The
+    axon tunnel reads back at only 2-8 MB/s with ~100 ms latency
+    (BASELINE.md caveat), so result bytes — not device FLOPs — are the
+    wall-clock cost of every point-returning program (PROFILE_r04.md).
+    fp_decode_batch inverts on dtype. COCONUT_DEBUG_PACK=1 asserts the
+    limb bound on-device."""
     if _os.environ.get("COCONUT_DEBUG_PACK") == "1":
 
         def _assert_bound(m):
             if not bool(m <= 396.0):
                 raise AssertionError(
-                    "_pack_pt limb |v| = %r exceeds the int16-pack bound 396"
+                    "_pack_pt limb |v| = %r exceeds the pack bound 396"
                     % float(m)
                 )
 
         for t in jax.tree_util.tree_leaves((x, y)):
             jax.debug.callback(_assert_bound, jnp.max(jnp.abs(t)))
-    f = lambda t: t.astype(jnp.int16)
+    from . import fp as _fp_mod
+
+    f = _fp_mod.pack_canon48
     return jax.tree_util.tree_map(f, x), jax.tree_util.tree_map(f, y)
 
 
 def _unpack_pt(x, y):
     """Inverse of _pack_pt for device-to-device consumers (the offset
-    path): int16 limbs back to the f32 the field ops run on (exact)."""
-    f = lambda t: t.astype(jnp.float32)
+    path): uint8 canonical digits back to f32 limb vectors (digits
+    0..255 are valid LAZY limbs; the +2p offset is absorbed mod p by the
+    downstream Montgomery arithmetic; limbs 48..51 restore as zeros)."""
+    from .limbs import NLIMBS as _NL
+
+    def f(t):
+        ft = t.astype(jnp.float32)
+        pad = jnp.zeros(ft.shape[:-1] + (_NL - ft.shape[-1],), jnp.float32)
+        return jnp.concatenate([ft, pad], axis=-1)
+
     return jax.tree_util.tree_map(f, x), jax.tree_util.tree_map(f, y)
 
 
@@ -280,12 +293,14 @@ def _msm_affine_kernel(field_is_fp2, wtables, mag, sgn):
 
 @jax.jit
 def _pairing_kernel(px, py, qx, qy, valid):
+    px, py, qx, qy = _pts_f32((px, py, qx, qy))
     return pr.pairing_product_is_one(px, py, qx, qy, valid)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
 def _msm_distinct_affine_kernel(field_is_fp2, x, y, inf, mag, sgn):
     fl = cv.FP2 if field_is_fp2 else cv.FP
+    x, y = _pts_f32((x, y))
     acc = cv.msm_distinct_signed(fl, x, y, inf, mag, sgn)
     ax, ay, ainf = cv.to_affine(fl, acc)
     return (*_pack_pt(ax, ay), ainf)
@@ -302,6 +317,7 @@ def _msm_distinct_plus_offset_kernel(
     assembly rides here instead of decoding pk^k and adding ~2B points
     on the host."""
     fl = cv.FP2 if field_is_fp2 else cv.FP
+    x, y = _pts_f32((x, y))
     acc = cv.msm_distinct_signed(fl, x, y, inf, mag, sgn)
     ox, oy = _unpack_pt(ox, oy)
     off = cv.affine_to_jacobian(fl, ox, oy, oinf)
@@ -323,6 +339,19 @@ def _msm_shared_many_kernel(field_is_fp2, jobs):
     return tuple(outs)
 
 
+def _pts_f32(tree):
+    """Uploaded point operands travel as int16 limb arrays (halved
+    host->device bytes over the 2-8 MB/s tunnel; balanced encodings are
+    exact integers |v| <= 132, so the int16 round trip is lossless);
+    the field ops run in f32 — cast at kernel entry, where XLA fuses it
+    into the first consumer. f32 inputs pass through unchanged, so
+    device-resident operands and the CPU test path are unaffected."""
+    return jax.tree_util.tree_map(
+        lambda t: t.astype(jnp.float32) if t.dtype != jnp.float32 else t,
+        tree,
+    )
+
+
 def verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2):
     """Post-MSM half of the fused verify: normalize the accumulator and run
     the 2-pair pairing product. Split out so the sharded path (shard.py) can
@@ -332,6 +361,7 @@ def verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2):
     g_tilde ladder and a merged [B] accumulator (pr.miller_two_pairs_
     shared_q2); the G2 assignment keeps the generic pair-set loop (there
     the shared element g_tilde sits on the evaluation side already)."""
+    s1, s2n, gtx, gty = _pts_f32((s1, s2n, gtx, gty))
     acc_fl = cv.FP2 if sig_is_g1 else cv.FP
     with jax.named_scope("affine_norm"):
         ax, ay, ainf = cv.to_affine(acc_fl, acc)
@@ -426,6 +456,7 @@ def fused_verify_combined(
     masked lanes as factor 1).
 
     B must be a power of two (host pads with valid=False lanes)."""
+    s1, s2n, gtx, gty = _pts_f32((s1, s2n, gtx, gty))
     acc_fl = cv.FP2 if sig_is_g1 else cv.FP
     sig_fl = cv.FP if sig_is_g1 else cv.FP2
     B = inf1.shape[0]
@@ -539,6 +570,7 @@ def grouped_accumulators(sig_fl, s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn):
     over the (local) credential batch -> projective accumulators [q+2].
     Split out so the dp-sharded path (shard.py) can combine cross-device
     partials (point sums commute) before the pairing tail."""
+    s1, s2n = _pts_f32((s1, s2n))
     acc1 = _grouped_msms(sig_fl, s1[0], s1[1], inf1, cmag, csgn)  # [q+1]
     acc2 = _grouped_msms(sig_fl, s2n[0], s2n[1], inf2, rmag, rsgn)  # [1]
     return jax.tree_util.tree_map(
@@ -549,6 +581,7 @@ def grouped_accumulators(sig_fl, s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn):
 def grouped_tail(sig_is_g1, allacc, ox, oy, gtx, gty, any_dead):
     """Post-MSM half of the grouped verify: q+2 Miller pairs against the
     fixed other-group points, one shared final exponentiation, one bool."""
+    ox, oy, gtx, gty = _pts_f32((ox, oy, gtx, gty))
     sig_fl = cv.FP if sig_is_g1 else cv.FP2
     px, py, pinf = cv.to_affine(sig_fl, allacc)  # [q+2] sig-group points
 
@@ -676,6 +709,7 @@ def fused_show_verify(
 
     All proofs must share the same revealed-index set (the bench shape;
     ps.batch_show_verify falls back per-proof otherwise)."""
+    jpt, commx, commy = _pts_f32((jpt, commx, commy))
     oth_fl = cv.FP2 if sig_is_g1 else cv.FP
 
     # -- Schnorr check ------------------------------------------------------
@@ -713,13 +747,23 @@ class JaxBackend(CurveBackend):
     name = "jax"
 
     # -- encoding helpers ----------------------------------------------------
+    #
+    # Point batches upload as int16 limb arrays: balanced Montgomery
+    # encodings are exact integers |v| <= 132, the tunnel moves bytes at
+    # 2-8 MB/s, and every consuming kernel casts back to f32 at entry
+    # (_pts_f32) — so the int16 wire halves the dominant operand transfer
+    # losslessly. The cast to int16 happens in NUMPY, before jnp.asarray
+    # commits the buffer to the device.
 
     @staticmethod
     def _encode_g1_points(points):
         xs = [(0 if p is None else p[0]) for p in points]
         ys = [(0 if p is None else p[1]) for p in points]
         inf = jnp.asarray(np.array([p is None for p in points]))
-        return (tw.encode_batch(xs), tw.encode_batch(ys)), inf
+        return (
+            tw.encode_batch(xs, dtype=np.int16),
+            tw.encode_batch(ys, dtype=np.int16),
+        ), inf
 
     @staticmethod
     def _encode_g2_points(points):
@@ -727,7 +771,10 @@ class JaxBackend(CurveBackend):
         xs = [(zero2 if p is None else p[0]) for p in points]
         ys = [(zero2 if p is None else p[1]) for p in points]
         inf = jnp.asarray(np.array([p is None for p in points]))
-        return (tw.encode_batch(xs), tw.encode_batch(ys)), inf
+        return (
+            tw.encode_batch(xs, dtype=np.int16),
+            tw.encode_batch(ys, dtype=np.int16),
+        ), inf
 
     # -- CurveBackend primitives --------------------------------------------
 

@@ -291,6 +291,53 @@ def inv(a):
     return pow_static(a, P - 2)
 
 
+# --- canonical byte packing (device-side readback compression) --------------
+
+_TWO_P_DIGITS_NP = np.array(
+    [((2 * P) >> (8 * i)) & 0xFF for i in range(NLIMBS)], dtype=np.float32
+)
+# 2p's top limbs: 2p < 2^382, so digits 48.. are zero — the 48-byte slice
+# below is exact for any packed |value| < 2p
+assert 2 * P < 1 << 383
+CANON_BYTES = 48
+
+
+def pack_canon48(t):
+    """f32 [..., 52] lazy limbs with |value| < 2p and |limbs| <= ~400 ->
+    uint8 [..., 48] base-256 digits of (value + 2p), a canonical-width
+    representative of value mod p. This is the device half of the
+    readback compression: 48 bytes per Fp instead of 104 (int16 x 52) —
+    the axon tunnel reads back at 2-8 MB/s, so result bytes are the wall
+    cost of every point-returning program (PROFILE_r04.md).
+
+    Exactness: adding 2p's digits (<= 255) to limbs |v| <= ~400 keeps
+    every limb in [-400, 655]; the full sequential carry scan (floor
+    semantics) produces exact base-256 digits of the nonnegative value
+    v + 2p in (0, 4p) subset [0, 2^383), whose digits 48..51 are zero and
+    are dropped. Every intermediate is an exact small f32 integer. The
+    host inverse is limbs.fp_decode_batch's uint8 path (value mod p after
+    the Montgomery divide).
+
+    Scan width: this scan carries a flat [lanes] f32 (no limb dim) and
+    stacks [52, lanes] — a DIFFERENT shape family from the comb-build
+    scans the axon backend corrupts above ~1028 carry lanes
+    (probes/README.md). Probed bit-exact on the chip at 2,048 / 8,192 /
+    65,536 lanes, all lanes checked, including negative-value lazy
+    inputs (probes/probe_pack.py, 2026-08-01); re-run that probe if the
+    scan structure here changes."""
+    v = t + jnp.asarray(_TWO_P_DIGITS_NP)
+
+    def step(c, d):
+        s = d + c
+        hi = jnp.floor(s * _INV_BASE)
+        return hi, s - hi * _BASE
+
+    vT = jnp.moveaxis(v, -1, 0)  # [52, ...]
+    _, digsT = lax.scan(step, jnp.zeros(v.shape[:-1], v.dtype), vT)
+    digs = jnp.moveaxis(digsT, 0, -1)
+    return digs[..., :CANON_BYTES].astype(jnp.uint8)
+
+
 # --- exact predicates (compress, then all-limbs-zero) -----------------------
 
 

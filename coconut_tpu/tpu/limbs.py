@@ -141,15 +141,29 @@ def fp_encode_batch(xs):
 
 
 def fp_decode_batch(arr):
-    """np.float32[..., NLIMBS] Montgomery -> list of canonical ints.
+    """Montgomery device output -> list of canonical ints. Two wire
+    formats, dispatched on dtype:
 
-    Vectorized: limbs are pre-combined into 48-bit chunks in int64 numpy
-    (exact: normalized limbs are |v| <= 132, so a 6-limb chunk is
-    < 6 * 132 * 2^40 < 2^51), leaving ~9 Python big-int ops per element
-    instead of NLIMBS — the decode side of the host codec was a visible
-    slice of issuance/show batch time."""
+      - uint8 [..., 48]: canonical base-256 digits of (value + 2p) from
+        fp.pack_canon48 (the compressed readback path) — int.from_bytes
+        per element, then the Montgomery divide mod p;
+      - any float/int [..., NLIMBS]: signed limb vectors. Vectorized:
+        limbs are pre-combined into 48-bit chunks in int64 numpy (exact:
+        packed limbs are |v| <= ~400, so a 6-limb chunk is
+        < 6 * 400 * 2^40 < 2^52), leaving ~9 Python big-int ops per
+        element instead of NLIMBS — the decode side of the host codec was
+        a visible slice of issuance/show batch time."""
     rinv = pow(MONT_R, -1, P)
-    a = np.asarray(arr, dtype=np.float64)
+    a0 = np.asarray(arr)
+    if a0.dtype == np.uint8:
+        flat = np.ascontiguousarray(a0.reshape(-1, a0.shape[-1]))
+        nb = flat.shape[1]
+        buf = flat.tobytes()
+        return [
+            int.from_bytes(buf[i * nb : (i + 1) * nb], "little") * rinv % P
+            for i in range(flat.shape[0])
+        ]
+    a = a0.astype(np.float64)
     flat = a.reshape(-1, a.shape[-1]).round().astype(np.int64)
     n, nl = flat.shape
     nchunk = -(-nl // 6)

@@ -27,17 +27,24 @@ from .limbs import NLIMBS, fp_encode
 # --- codecs (host-side) -----------------------------------------------------
 
 
-def encode_batch(elems):
+def encode_batch(elems, dtype=None):
     """List of same-structure spec elements (ints / nested tuples) ->
-    pytree of Montgomery limb arrays with leading batch dim."""
+    pytree of Montgomery limb arrays with leading batch dim. dtype
+    converts in NUMPY before the device transfer (int16 is the halved
+    point-upload wire format — balanced limbs are exact |v| <= 132; the
+    consuming kernels cast back to f32 at entry)."""
     first = elems[0]
     if isinstance(first, tuple):
         return tuple(
-            encode_batch([e[i] for e in elems]) for i in range(len(first))
+            encode_batch([e[i] for e in elems], dtype=dtype)
+            for i in range(len(first))
         )
     from .limbs import fp_encode_batch
 
-    return jnp.asarray(fp_encode_batch(elems))
+    arr = fp_encode_batch(elems)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    return jnp.asarray(arr)
 
 
 def decode_batch(tree):

@@ -1,0 +1,27 @@
+"""probe_pack.py [n]: pack_canon48 bit-exactness at wide lane counts on the
+chip — the carry scan stacks a [52, n] output; the comb-build scan family
+corrupts above ~1028 lanes (probes/README.md), so the pack scan's safe
+width must be established empirically, all lanes checked."""
+import sys
+import numpy as np
+import jax, jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+import coconut_tpu.tpu
+coconut_tpu.tpu.enable_compile_cache()
+from coconut_tpu.ops.fields import P
+from coconut_tpu.tpu import fp
+from coconut_tpu.tpu.limbs import MONT_R, balanced_limbs_batch, fp_decode_batch
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+rng = np.random.default_rng(42)
+ints = [int(x) % P for x in rng.integers(1, 2**63, size=n)]
+ints[0] = 0
+ints[1] = P - 1
+a = balanced_limbs_batch([v * MONT_R % P for v in ints])
+b = balanced_limbs_batch([(P - v) % P * MONT_R % P for v in ints])
+lazy = a - 2.0 * b  # negative-value lazy combination, |value| < 2p
+packed = jax.jit(fp.pack_canon48)(jnp.asarray(lazy))
+got = fp_decode_batch(np.asarray(packed))
+bad = sum(g != (3 * v) % P for g, v in zip(got, ints))
+print("pack_canon48 n=%d bad=%d" % (n, bad))

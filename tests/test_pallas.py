@@ -140,3 +140,43 @@ class TestInterpretedKernel:
         want = np.asarray(fp.mul(jnp.asarray(a), jnp.asarray(b)))
         np.testing.assert_array_equal(got, want)
         assert np.abs(got).max() <= 132  # NORMALIZED output class
+
+
+class TestPackCanon48:
+    """fp.pack_canon48 + the uint8 decode path: exact round-trip."""
+
+    def test_roundtrip_extremes(self):
+        import jax
+
+        from coconut_tpu.tpu.limbs import balanced_limbs_batch
+
+        # representatives with negative values and extreme limbs: scale
+        # balanced encodings by +/-3 (lazy class, |value| < 2p after the
+        # 3x of a < 0.66p... use values < p/2 to stay inside the bound)
+        ints = [0, 1, P - 1, P // 2, 12345, (P - 5) // 3]
+        mont = [v * MONT_R % P for v in ints]
+        base = balanced_limbs_batch(mont)
+        cases = {
+            "plain": (base, 1),
+            "neg": (-base, -1),
+        }
+        for name, (arr, sign) in cases.items():
+            packed = jax.jit(fp.pack_canon48)(jnp.asarray(arr))
+            got = fp_decode_batch(np.asarray(packed))
+            for g, v in zip(got, ints):
+                assert g == (sign * v) % P, name
+
+    def test_lazy_combination_roundtrip(self):
+        import jax
+
+        from coconut_tpu.tpu.limbs import balanced_limbs_batch
+
+        a = [v % P for v in (7, P - 3, 2**200)]
+        b = [v % P for v in (P - 1, 5, 2**380)]
+        ea = balanced_limbs_batch([v * MONT_R % P for v in a])
+        eb = balanced_limbs_batch([v * MONT_R % P for v in b])
+        lazy = ea - eb  # 2-term lazy combination, possibly negative
+        packed = jax.jit(fp.pack_canon48)(jnp.asarray(lazy))
+        got = fp_decode_batch(np.asarray(packed))
+        for g, ai, bi in zip(got, a, b):
+            assert g == (ai - bi) % P
