@@ -82,16 +82,34 @@ def _dispatchers(backend, mode, mesh=None):
     multi-chip — SURVEY §2.3 PP+DP rows combined: the batch is sharded
     across devices AND host encode pipelines under device execution)."""
     if mesh is not None:
-        if mode != "grouped":
+        if mode not in ("grouped", "per_credential"):
             raise ValueError(
-                "mesh streaming requires mode='grouped' (got %r)" % (mode,)
+                "mesh streaming supports mode='grouped' or "
+                "'per_credential' (got %r)" % (mode,)
             )
-        if not hasattr(backend, "encode_grouped_batch"):
+        needed = (
+            "encode_verify_batch"
+            if mode == "per_credential"
+            else "encode_grouped_batch"
+        )
+        if not hasattr(backend, needed):
             raise ValueError(
-                "backend %r cannot shard over a mesh (no "
-                "encode_grouped_batch); use the jax backend" % (backend,)
+                "backend %r cannot shard over a mesh (no %s); "
+                "use the jax backend" % (backend, needed)
             )
         from .tpu import shard as _shard
+
+        if mode == "per_credential":
+            # dp-sharded fused per-credential program: [B] bools per
+            # batch (the reference's Signature::verify verdict semantics
+            # at ledger scale on a mesh)
+
+            def dispatch(s, m, vk, params):
+                return _shard.batch_verify_sharded_async(
+                    backend, s, m, vk, params, mesh
+                )
+
+            return dispatch, _record_percred, True
 
         def dispatch(s, m, vk, params):
             return _shard.batch_verify_grouped_sharded_async(
@@ -110,11 +128,7 @@ def _dispatchers(backend, mode, mesh=None):
         else:
             dispatch = async_fn
 
-        def record(state, bits, _n):
-            state.verified += sum(1 for b in bits if b)
-            state.failed += sum(1 for b in bits if not b)
-
-        return dispatch, record, async_fn is not None
+        return dispatch, _record_percred, async_fn is not None
     if mode == "grouped":
         async_fn = getattr(backend, "batch_verify_grouped_async", None)
         if async_fn is None:
@@ -133,6 +147,13 @@ def _dispatchers(backend, mode, mesh=None):
 
         return dispatch, _record_grouped, async_fn is not None
     raise ValueError("unknown stream mode %r" % (mode,))
+
+
+def _record_percred(state, bits, _n):
+    """Per-credential accounting (single-chip and mesh paths share it):
+    one bool per credential."""
+    state.verified += sum(1 for b in bits if b)
+    state.failed += sum(1 for b in bits if not b)
 
 
 def _record_grouped(state, ok, n):

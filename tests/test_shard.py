@@ -167,3 +167,67 @@ def test_sharded_grouped_stream(mesh_devices, fixture8, tmp_path):
     assert state.batches_ok == 2 and state.batches_failed == 1
     assert state.verified == 16 and state.failed == 8
     assert state.next_batch == 3
+
+
+def test_sharded_issuance(mesh_devices, fixture8):
+    """Config 4 on a mesh: batch_prepare_blind_sign + batch_blind_sign +
+    batch_unblind run with every issuance-shape MSM program dp-sharded
+    (ShardedIssuanceBackend), bit-identical to the spec per-request path
+    (BlindSignature.new is deterministic given a request) and yielding
+    credentials that verify (reference signature.rs:124-207, 380-443)."""
+    from coconut_tpu.elgamal import elgamal_keygen
+    from coconut_tpu.signature import (
+        BlindSignature,
+        batch_blind_sign,
+        batch_prepare_blind_sign,
+        batch_unblind,
+    )
+    from coconut_tpu.tpu.shard import ShardedIssuanceBackend, default_mesh
+
+    params, sk, vk, _, msgs_list = fixture8
+    mesh = default_mesh(ndp=8, ntp=1, devices=mesh_devices)
+    be = ShardedIssuanceBackend(mesh)
+    esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+    out = batch_prepare_blind_sign(msgs_list, 2, epk, params, backend=be)
+    reqs = [r for r, _ in out]
+    blinded = batch_blind_sign(reqs, sk, params, backend=be)
+    for req, bs in zip(reqs, blinded):
+        want = BlindSignature.new(req, sk, params)
+        assert (bs.h, bs.blinded) == (want.h, want.blinded)
+    unblinded = batch_unblind(blinded, esk, params.ctx, backend=be)
+    for sig, msgs in zip(unblinded, msgs_list):
+        assert ps_verify(sig, msgs, vk, params)
+
+
+def test_sharded_percred_stream(mesh_devices, fixture8, tmp_path):
+    """verify_stream(mode='per_credential') on a mesh: per-credential
+    verdict bits at ledger scale, dp+tp sharded (the r4 restriction to
+    grouped-only mesh streaming is lifted). Reuses the (4,2)-mesh percred
+    program test_sharded_percred_verify compiles."""
+    from coconut_tpu.stream import verify_stream
+    from coconut_tpu.tpu.backend import JaxBackend
+    from coconut_tpu.tpu.shard import default_mesh
+
+    params, _, vk, sigs, msgs_list = fixture8
+    sigs, msgs_list = list(sigs[:4]), msgs_list[:4]
+    forged = list(sigs)
+    forged[2] = Signature(
+        forged[2].sigma_1, params.ctx.sig.mul(forged[2].sigma_2, 2)
+    )
+    mesh = default_mesh(ndp=4, ntp=2, devices=mesh_devices)
+
+    def source(i):
+        return (sigs, msgs_list) if i != 1 else (forged, msgs_list)
+
+    state = verify_stream(
+        source,
+        3,
+        vk,
+        params,
+        JaxBackend(),
+        state_path=str(tmp_path / "pc.json"),
+        mode="per_credential",
+        mesh=mesh,
+    )
+    assert state.verified == 11 and state.failed == 1
+    assert state.next_batch == 3
