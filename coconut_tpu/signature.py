@@ -687,10 +687,15 @@ def batch_blind_sign(sig_requests, sigkey, params, backend=None):
     Returns [B] BlindSignature.
 
     Timing discipline: the scalars here are the signer's long-term secrets
-    (the reference runs these MSMs const-time, signature.rs:424-428). Pass
-    backend="cpp_ct" for the native masked-lookup schedule; the default
-    Python spec path and the JAX path are variable-time hosts and suitable
-    for development / throughput benchmarking, not hostile co-tenancy."""
+    (the reference runs these MSMs const-time, signature.rs:424-428). The
+    JAX device path is a static XLA schedule whose execution time is
+    measured independent of secret digit values (CONSTTIME.md: 3% median
+    spread across digit-extreme keys, under the tunnel's own noise floor);
+    its residual caveat is host-side big-int encode work with
+    bit-length-correlated sub-ms timing. Pass backend="cpp_ct" for the
+    native masked-lookup schedule when host-resident attackers with
+    sub-ms timing oracles are in scope; the Python spec path is a
+    variable-time development vehicle only."""
     from .backend import get_backend
 
     if not sig_requests:

@@ -1,0 +1,74 @@
+"""probe_ct.py: timing-independence measurement of the device issuance
+path over adversarial signer secrets (CONSTTIME.md's data source).
+
+Measures, per secret pattern:
+  - host encode time (digit recode + GLV split — the only host work that
+    touches secret values), and
+  - end-to-end batch_blind_sign wall time (best and median of REPS),
+on the SAME fixed request batch. Patterns span the digit-value extremes
+the gather indices take. Run on the real chip:
+    python probes/probe_ct.py [batch] [reps]
+"""
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import coconut_tpu.tpu
+
+coconut_tpu.tpu.enable_compile_cache()
+import __graft_entry__ as ge
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.ops.fields import R
+from coconut_tpu.signature import Sigkey, batch_blind_sign, batch_prepare_blind_sign
+from coconut_tpu.tpu.backend import JaxBackend, _signed_digits
+from coconut_tpu.tpu import glv
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 1024
+REPS = int(sys.argv[2]) if len(sys.argv) > 2 else 9
+
+params, _, vk, sigs, msgs_list = ge._fixture(batch=B)
+be = JaxBackend()
+esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+out = batch_prepare_blind_sign(msgs_list, 2, epk, params, backend=be)
+reqs = [r for r, _ in out]
+
+# digit-extreme scalar: every signed 5-bit digit at max magnitude
+DENSE = sum(16 * (32**i) for i in range(51)) % R
+PATTERNS = {
+    "zeros": Sigkey(0, [0] * ge.MSG_COUNT),
+    "ones": Sigkey(1, [1] * ge.MSG_COUNT),
+    "dense_max_digits": Sigkey(DENSE, [DENSE] * ge.MSG_COUNT),
+    "r_minus_1": Sigkey(R - 1, [R - 1] * ge.MSG_COUNT),
+    "random": Sigkey(
+        0x6A09E667F3BCC908 * 0x243F6A8885A308D3 % R,
+        [(0x9E3779B97F4A7C15 * (i + 1) ** 5) % R for i in range(ge.MSG_COUNT)],
+    ),
+}
+
+# untimed warmup: numpy/CPython allocator first-touch costs otherwise land
+# on whichever pattern runs first and masquerade as data dependence
+_warm = [[1, 2, 3]] * (2 * B)
+_ = [[h for s in row for h in glv.decompose(s)] for row in _warm]
+_signed_digits(_, nwin=glv.NWIN_5)
+
+print("pattern, host_encode_ms, wall_best_s, wall_median_s (B=%d)" % B)
+for name, sk in PATTERNS.items():
+    # host-side secret handling in isolation: GLV split + digit recode of
+    # the 2B scalar rows the fused blind-sign program uploads
+    scal_rows = [list(sk.y[:2]) + [0]] * B + [list(sk.y[:2]) + [sk.x]] * B
+    t0 = time.perf_counter()
+    split = [[h for s in row for h in glv.decompose(s)] for row in scal_rows]
+    _signed_digits(split, nwin=glv.NWIN_5)
+    host_ms = (time.perf_counter() - t0) * 1e3
+
+    batch_blind_sign(reqs, sk, params, backend=be)  # warm/compile
+    walls = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        batch_blind_sign(reqs, sk, params, backend=be)
+        walls.append(time.perf_counter() - t0)
+    print(
+        "%-18s %8.1f %10.4f %10.4f"
+        % (name, host_ms, min(walls), statistics.median(walls))
+    )
