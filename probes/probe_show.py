@@ -1,0 +1,28 @@
+"""probe_show.py: cProfile the warm batch_show at B=1024 on the chip."""
+import cProfile, pstats, sys, time
+import sys; sys.path.insert(0, "/root/repo")
+import coconut_tpu.tpu
+coconut_tpu.tpu.enable_compile_cache()
+sys.path.insert(0, "/root/repo")
+import __graft_entry__ as ge
+from coconut_tpu.pok_sig import batch_show
+from coconut_tpu.tpu.backend import JaxBackend
+
+params, sk, vk, sigs, msgs_list = ge._fixture(batch=1024)
+be = JaxBackend()
+t0 = time.time()
+batch_show(sigs, vk, params, msgs_list, {2, 3, 4, 5}, backend=be)
+print("compile+run %.1fs" % (time.time() - t0))
+best = None
+for _ in range(3):
+    t0 = time.time()
+    batch_show(sigs, vk, params, msgs_list, {2, 3, 4, 5}, backend=be)
+    dt = time.time() - t0
+    best = dt if best is None else min(best, dt)
+print("warm best %.3fs -> %.0f/s" % (best, 1024 / best))
+pr = cProfile.Profile()
+pr.enable()
+batch_show(sigs, vk, params, msgs_list, {2, 3, 4, 5}, backend=be)
+pr.disable()
+st = pstats.Stats(pr)
+st.sort_stats("cumulative").print_stats(28)
