@@ -36,3 +36,11 @@ try:
     coconut_tpu.tpu.enable_compile_cache()
 except ImportError:  # pragma: no cover - jax is baked into this image
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "heavy: multi-minute at-scale fused-kernel tests, run by ci.sh's "
+        "separate heavy-lane process (COCONUT_TEST_HEAVY=1, -m heavy)",
+    )
