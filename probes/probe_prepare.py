@@ -1,0 +1,27 @@
+"""probe_prepare.py: cProfile the warm batch_prepare_blind_sign at B=1024."""
+import cProfile, pstats, sys, time
+sys.path.insert(0, "/root/repo")
+import coconut_tpu.tpu
+coconut_tpu.tpu.enable_compile_cache()
+import __graft_entry__ as ge
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.signature import batch_prepare_blind_sign
+from coconut_tpu.tpu.backend import JaxBackend
+
+params, sk, vk, sigs, msgs_list = ge._fixture(batch=1024)
+be = JaxBackend()
+esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+t0 = time.time()
+batch_prepare_blind_sign(msgs_list, 2, epk, params, backend=be)
+print("compile+run %.1fs" % (time.time() - t0))
+best = None
+for _ in range(3):
+    t0 = time.time()
+    batch_prepare_blind_sign(msgs_list, 2, epk, params, backend=be)
+    dt = time.time() - t0
+    best = dt if best is None else min(best, dt)
+print("warm best %.3fs -> %.0f req/s" % (best, 1024 / best))
+pr = cProfile.Profile(); pr.enable()
+batch_prepare_blind_sign(msgs_list, 2, epk, params, backend=be)
+pr.disable()
+pstats.Stats(pr).sort_stats("cumulative").print_stats(22)
