@@ -947,3 +947,17 @@ class TestBenchShapeHeavy:
         )
         assert not bits[mid] and int(bits.sum()) == len(sigs) - 1
         # monkeypatch teardown restores _C_SCHED and the env var
+
+
+def test_comb_window_guard_rejects_unsupported_widths(monkeypatch):
+    """COCONUT_COMB_WINDOW outside [1, 9] must fail loudly — 10 is
+    blocked by the probed axon Fp2 table-build miscompile, not algebra
+    (probes/README.md), and silently wrong G2 MSMs are the alternative."""
+    from coconut_tpu.tpu import backend as tbe
+
+    for bad in ("0", "10", "11"):
+        monkeypatch.setenv("COCONUT_COMB_WINDOW", bad)
+        with pytest.raises(ValueError, match="capped at 9"):
+            tbe._comb_window_default()
+    monkeypatch.setenv("COCONUT_COMB_WINDOW", "9")
+    assert tbe._comb_window_default() == 9

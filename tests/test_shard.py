@@ -231,3 +231,36 @@ def test_sharded_percred_stream(mesh_devices, fixture8, tmp_path):
     )
     assert state.verified == 11 and state.failed == 1
     assert state.next_batch == 3
+
+
+def test_sharded_issuance_rejects_indivisible_batch(mesh_devices, fixture8):
+    """ShardedIssuanceBackend fails fast (before any device work) when a
+    row count does not divide the dp extent."""
+    from coconut_tpu.elgamal import elgamal_keygen
+    from coconut_tpu.signature import batch_prepare_blind_sign
+    from coconut_tpu.tpu.shard import ShardedIssuanceBackend, default_mesh
+
+    params, _, _, _, msgs_list = fixture8
+    mesh = default_mesh(ndp=8, ntp=1, devices=mesh_devices)
+    be = ShardedIssuanceBackend(mesh)
+    _, epk = elgamal_keygen(params.ctx.sig, params.g)
+    with pytest.raises(ValueError, match="not divisible"):
+        batch_prepare_blind_sign(msgs_list[:3], 2, epk, params, backend=be)
+
+
+def test_mesh_stream_mode_and_backend_validation(mesh_devices):
+    """verify_stream(mesh=...) rejects unknown modes and backends without
+    the encode surface the chosen mode needs — with the mode's own
+    attribute named in the error (stream.py capability probe)."""
+    from coconut_tpu.backend import get_backend
+    from coconut_tpu.stream import _dispatchers
+    from coconut_tpu.tpu.shard import default_mesh
+
+    mesh = default_mesh(ndp=8, ntp=1, devices=mesh_devices)
+    py = get_backend("python")
+    with pytest.raises(ValueError, match="grouped.*per_credential|per_credential"):
+        _dispatchers(py, "combined", mesh=mesh)
+    with pytest.raises(ValueError, match="encode_verify_batch"):
+        _dispatchers(py, "per_credential", mesh=mesh)
+    with pytest.raises(ValueError, match="encode_grouped_batch"):
+        _dispatchers(py, "grouped", mesh=mesh)
