@@ -24,7 +24,9 @@ Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
 BENCH_BACKEND (jax|python), BENCH_PERCRED/BENCH_SHOW/BENCH_ISSUE (default 1),
 BENCH_STREAM (default 1 — config 5 is driver-captured), BENCH_STREAM_BATCHES
-(default 8), BENCH_ISSUE_N (default 1024), BENCH_COMBINED (default 0).
+(default 8), BENCH_ISSUE_N (default 1024), BENCH_COMBINED (default 0),
+BENCH_MULTIVK (default 0 — 8-verkey rotation datapoint), BENCH_PROFILE
+(default 0 — one traced rep of the headline to BENCH_PROFILE_DIR).
 """
 
 import json
