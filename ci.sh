@@ -19,6 +19,36 @@ make -C native selftest_asan
 
 echo "== test suite (both group assignments in-suite) =="
 python -m pytest tests/ -q
+
+echo "== fault-supervision lane (retry/fallback/bisection/checkpoints) =="
+python -m pytest tests/test_faults.py -m faults -q
+# dead-letter JSONL schema probe: run a tiny grouped stream with one forged
+# credential and grep the bisection output for the documented keys
+DLQ=$(mktemp -d)/dead.jsonl
+DLQ_PATH="$DLQ" python - <<'EOF'
+import os
+from types import SimpleNamespace
+from coconut_tpu.stream import verify_stream
+
+def cred(ok=True):
+    return SimpleNamespace(sigma_1=1, sigma_2=1, ok=ok)
+
+def source(i):
+    sigs = [cred(ok=not (i == 1 and j == 2)) for j in range(4)]
+    return sigs, [[0]] * 4
+
+class Grouped:
+    def batch_verify_grouped(self, sigs, msgs, vk, params):
+        return all(s.ok for s in sigs)
+
+verify_stream(source, 3, None, None, Grouped(), mode="grouped",
+              dead_letter_path=os.environ["DLQ_PATH"])
+EOF
+grep -q '"batch": 1' "$DLQ"
+grep -q '"credential": 2' "$DLQ"
+grep -q '"reason"' "$DLQ"
+grep -q '"attempts"' "$DLQ"
+echo "dead-letter schema: ok"
 if [ "${CI_HEAVY:-0}" = "1" ]; then
   # Heavy lane in its OWN process: the at-scale B=1024 programs
   # accumulate ~25 GB of compiled XLA CPU state, and one combined

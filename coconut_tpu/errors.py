@@ -42,3 +42,37 @@ class DeserializationError(CoconutError):
 
 class GeneralError(CoconutError):
     """Catch-all with a message (errors.rs:22-23)."""
+
+
+class TransientBackendError(CoconutError):
+    """A backend dispatch or readback failure that is expected to succeed
+    on re-attempt (device preemption, tunnel RPC hiccup, transient transfer
+    failure). The stream supervision layer (stream.verify_stream +
+    retry.RetryPolicy) retries these with bounded backoff and then falls
+    back to a designated backend; any other exception class is treated as
+    permanent and propagates immediately."""
+
+
+class CheckpointCorruptError(CoconutError):
+    """A stream checkpoint file failed integrity validation: truncated or
+    unparseable bytes, an unknown schema version, or a CRC mismatch.
+    stream.StreamState catches this internally, quarantines the file aside
+    (`<path>.corrupt*`) and restarts cleanly — it must never surface as a
+    bare json.JSONDecodeError mid-resume."""
+
+
+class CheckpointMismatchError(CoconutError):
+    """A structurally-valid checkpoint belongs to a DIFFERENT run: its
+    stored run-config fingerprint (result mode + verkey digest —
+    stream.run_fingerprint) disagrees with the resuming run's. Unlike
+    corruption this fails loudly instead of quarantining: silently resuming
+    the wrong run would produce tallies for a stream nobody asked about."""
+
+    def __init__(self, stored, expected):
+        super().__init__(
+            "checkpoint fingerprint %s does not match this run's %s: "
+            "refusing to resume a different run's state (delete or move "
+            "the state file to start over)" % (stored, expected)
+        )
+        self.stored = stored
+        self.expected = expected

@@ -1,0 +1,160 @@
+"""Deterministic fault injection + the dead-letter sink.
+
+Two pieces of the stream supervision layer that live OUTSIDE the happy
+path:
+
+  - `FaultyBackend` wraps any verify-capable backend and injects scheduled
+    faults at exactly the seam `stream.verify_stream` dispatches through:
+    raise-on-Nth-dispatch transient errors, flipped verdicts, and corrupted
+    (raising) finalizers. Schedules are index-based and fully
+    deterministic, so tests/test_faults.py proves the retry / fallback /
+    bisection paths without flaky randomness.
+
+  - `DeadLetterLog` is the append-only JSONL file that receives culprit
+    credentials isolated by grouped-failure bisection: one object per
+    line with the batch index, the credential's index within the batch,
+    a reason, and the batch's retry attempt history. JSONL so a ledger
+    operator can grep/stream it without loading a document; ci.sh greps
+    the schema as a smoke check.
+"""
+
+import json
+import os
+
+from .errors import TransientBackendError
+
+# the verify entry points verify_stream._dispatchers probes for; faults are
+# injected only on these, everything else delegates untouched
+_SYNC_VERIFY = frozenset({"batch_verify", "batch_verify_grouped"})
+_ASYNC_VERIFY = frozenset({"batch_verify_async", "batch_verify_grouped_async"})
+
+
+class FaultyBackend:
+    """Capability-transparent fault-injecting wrapper around a backend.
+
+    Attribute access delegates to the wrapped backend, so a wrapped
+    backend exposes exactly the verify capabilities of the inner one
+    (`hasattr` probes in stream._dispatchers see through the wrapper).
+    A single dispatch counter ticks across all wrapped verify methods;
+    schedules address dispatches by that 0-based global index:
+
+      raise_every=N  — every Nth dispatch (indices N-1, 2N-1, ...) raises
+                       `error` at dispatch time, before the inner backend
+                       runs (a device/tunnel failure on submit);
+      raise_on       — explicit dispatch indices that raise at dispatch;
+      flip_on        — dispatch indices whose verdicts are negated
+                       (elementwise for per-credential lists, the single
+                       bool for grouped) — a miscompute, not a crash;
+      corrupt_finalizer_on — dispatch indices whose readback raises
+                       `error`: for async seams the returned finalizer
+                       raises when settled; for sync seams the call raises
+                       after the inner compute (the result is lost in
+                       flight).
+
+    `error` is the exception class raised (default TransientBackendError;
+    pass e.g. RuntimeError to model a permanent fault)."""
+
+    def __init__(
+        self,
+        inner,
+        raise_every=None,
+        raise_on=(),
+        flip_on=(),
+        corrupt_finalizer_on=(),
+        error=TransientBackendError,
+    ):
+        self.inner = inner
+        self.raise_every = raise_every
+        self.raise_on = frozenset(raise_on)
+        self.flip_on = frozenset(flip_on)
+        self.corrupt_finalizer_on = frozenset(corrupt_finalizer_on)
+        self.error = error
+        self.dispatches = 0
+
+    def _tick(self):
+        idx = self.dispatches
+        self.dispatches += 1
+        return idx
+
+    def _dispatch_faulted(self, idx):
+        if self.raise_every and (idx + 1) % self.raise_every == 0:
+            return True
+        return idx in self.raise_on
+
+    def _mangle(self, idx, result):
+        if idx in self.flip_on:
+            if isinstance(result, list):
+                return [not b for b in result]
+            return not result
+        return result
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        if name in _SYNC_VERIFY:
+
+            def sync_injected(*args, **kwargs):
+                idx = self._tick()
+                if self._dispatch_faulted(idx):
+                    raise self.error(
+                        "injected dispatch fault #%d (%s)" % (idx, name)
+                    )
+                result = attr(*args, **kwargs)
+                if idx in self.corrupt_finalizer_on:
+                    raise self.error(
+                        "injected readback fault #%d (%s)" % (idx, name)
+                    )
+                return self._mangle(idx, result)
+
+            return sync_injected
+        if name in _ASYNC_VERIFY:
+
+            def async_injected(*args, **kwargs):
+                idx = self._tick()
+                if self._dispatch_faulted(idx):
+                    raise self.error(
+                        "injected dispatch fault #%d (%s)" % (idx, name)
+                    )
+                fin = attr(*args, **kwargs)
+
+                def finalize():
+                    if idx in self.corrupt_finalizer_on:
+                        raise self.error(
+                            "injected finalizer fault #%d (%s)" % (idx, name)
+                        )
+                    return self._mangle(idx, fin())
+
+                return finalize
+
+            return async_injected
+        return attr
+
+
+class DeadLetterLog:
+    """Append-only JSONL sink for credentials the stream could not accept.
+
+    One object per line, keys sorted for grep-ability:
+      {"attempts": [...], "batch": int, "credential": int, "reason": str}
+    where `credential` is the index WITHIN the batch and `attempts` is the
+    batch's retry attempt history (retry.note_attempt records)."""
+
+    def __init__(self, path):
+        self.path = path
+
+    def append(self, batch, credential, reason, attempts=()):
+        rec = {
+            "batch": int(batch),
+            "credential": int(credential),
+            "reason": reason,
+            "attempts": list(attempts),
+        }
+        with open(self.path, "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+        return rec
+
+    @staticmethod
+    def read(path):
+        """All records in `path` (empty list if it does not exist)."""
+        if not os.path.exists(path):
+            return []
+        with open(path) as f:
+            return [json.loads(line) for line in f if line.strip()]

@@ -7,6 +7,14 @@ readback), monotonic counters (verifies, batches, transfer bytes), and a
 `snapshot()` the bench harness embeds in its JSON output so TPU claims are
 auditable.
 
+The stream supervision layer (stream.py / retry.py) reports its fault
+handling through the same counters so `snapshot()` is the single audit
+surface: "retries" (re-attempts after a transient backend error),
+"fallbacks" (batches re-dispatched on the fallback backend after retries
+exhausted), "bisections" (grouped-failure splits while isolating culprit
+credentials), "dead_letters" (culprits appended to the dead-letter JSONL),
+and "checkpoint_quarantined" (corrupt state files moved aside on resume).
+
 Zero-cost when unused: plain dicts, no background threads, no deps.
 Device-side profiling is separate: the hot kernels in tpu/backend.py carry
 `jax.named_scope` annotations (comb_msm, grouped_tables /
@@ -38,6 +46,11 @@ def timer(name):
 def count(name, n=1):
     """Add n to the counter `name` (e.g. "verifies", "transfer_bytes")."""
     _counts[name] += n
+
+
+def get_count(name):
+    """Current value of counter `name` (0 if never counted)."""
+    return _counts.get(name, 0)
 
 
 def snapshot():

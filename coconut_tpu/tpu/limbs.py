@@ -140,6 +140,35 @@ def fp_encode_batch(xs):
     return balanced_limbs_batch([int(x) % P * MONT_R % P for x in xs])
 
 
+# COCONUT_DEBUG_PACK support: backend._pack_pt's on-device bound check
+# cannot raise from inside jax.debug.callback (the runtime may swallow or
+# defer callback exceptions under jit), so the callback RECORDS violations
+# here and the host decode boundary asserts — every packed result funnels
+# through fp_decode_batch, so a violation surfaces on the very readback it
+# corrupted, as a real host-side exception.
+PACK_DEBUG_VIOLATIONS = []
+
+
+def pack_debug_record(m):
+    """jax.debug.callback target: record a limb-magnitude maximum that
+    exceeds pack_canon48's |v| <= 396 contract."""
+    v = float(np.asarray(m))
+    if v > 396.0:
+        PACK_DEBUG_VIOLATIONS.append(v)
+
+
+def pack_debug_check():
+    """Raise (and drain) if any recorded limb magnitude broke the pack
+    bound; called at the fp_decode_batch entry so the assert fires at the
+    host decode boundary."""
+    if PACK_DEBUG_VIOLATIONS:
+        worst = max(PACK_DEBUG_VIOLATIONS)
+        del PACK_DEBUG_VIOLATIONS[:]
+        raise AssertionError(
+            "_pack_pt limb |v| = %r exceeds the pack bound 396" % worst
+        )
+
+
 def fp_decode_batch(arr):
     """Montgomery device output -> list of canonical ints. Two wire
     formats, dispatched on dtype:
@@ -153,6 +182,7 @@ def fp_decode_batch(arr):
         < 6 * 400 * 2^40 < 2^52), leaving ~9 Python big-int ops per
         element instead of NLIMBS — the decode side of the host codec was
         a visible slice of issuance/show batch time."""
+    pack_debug_check()  # surface any COCONUT_DEBUG_PACK violation here
     rinv = pow(MONT_R, -1, P)
     a0 = np.asarray(arr)
     if a0.dtype == np.uint8:

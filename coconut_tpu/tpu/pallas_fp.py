@@ -112,7 +112,34 @@ _VPU = os.environ.get("COCONUT_PALLAS_VPU", "1") == "1"
 #   <= 128 + 2 <= 132 (the NORMALIZED class bound, as in fp.py).
 # COCONUT_PALLAS_KARATSUBA: 0 = plain outer product, 1 = one level,
 # 2 = two levels (default).
-_KARATSUBA = int(os.environ.get("COCONUT_PALLAS_KARATSUBA", "2"))
+
+
+def _parse_karatsuba(raw, default=2):
+    """Parse the COCONUT_PALLAS_KARATSUBA setting: unset/empty/garbage or
+    a negative value falls back to the default (a typo'd env var must not
+    crash import or silently pick a random depth); a level > 2 is an
+    explicit error — the exactness proof above covers at most two levels,
+    so deeper recursion would run UNPROVEN arithmetic."""
+    if raw is None:
+        return default
+    raw = raw.strip()
+    if not raw:
+        return default
+    try:
+        level = int(raw)
+    except ValueError:
+        return default
+    if level < 0:
+        return default
+    if level > 2:
+        raise ValueError(
+            "COCONUT_PALLAS_KARATSUBA=%d unsupported: the exactness proof "
+            "covers at most two levels (use 0, 1, or 2)" % level
+        )
+    return level
+
+
+_KARATSUBA = _parse_karatsuba(os.environ.get("COCONUT_PALLAS_KARATSUBA"))
 _HALF = NLIMBS // 2  # 26
 
 

@@ -36,6 +36,22 @@ from . import curve as cv
 _PROGRAM_CACHE = {}
 
 
+def require_axes(mesh, *axes):
+    """Check that `mesh` names every axis in `axes`, with a clear error up
+    front instead of a bare KeyError from mesh.shape['tp'] deep inside the
+    first batch's dispatch."""
+    missing = [a for a in axes if a not in mesh.shape]
+    if missing:
+        raise ValueError(
+            "mesh is missing axis(es) %s: it has %s; build the mesh with "
+            "shard.default_mesh() or Mesh(devices, ('dp', 'tp'))"
+            % (
+                ", ".join(repr(a) for a in missing),
+                tuple(mesh.shape) or "no axes",
+            )
+        )
+
+
 def _shard_map(local, mesh, in_specs, out_specs):
     """shard_map with the check_vma/check_rep spelling fallback (the
     scans initialize carries from replicated constants that become
@@ -171,6 +187,7 @@ def batch_verify_grouped_sharded(
     two divisible by the dp extent (pad_batch_to, default 2x the dp
     extent; the dryrun passes ndp for the one-lane-per-device minimum);
     per-device slices stay powers of two (fold_points requires it)."""
+    require_axes(mesh, batch_axis)
     ndp = mesh.shape[batch_axis]
     if ndp & (ndp - 1):
         raise ValueError("dp extent %d must be a power of two" % ndp)
@@ -196,6 +213,7 @@ def batch_verify_grouped_sharded_async(
     sharded grouped program (JAX dispatch is asynchronous) and returns a
     zero-arg finalizer, so `stream.verify_stream` can overlap batch i+1's
     host encode with batch i's mesh execution — config 5 on a mesh."""
+    require_axes(mesh, batch_axis)
     ndp = mesh.shape[batch_axis]
     if ndp & (ndp - 1):
         raise ValueError("dp extent %d must be a power of two" % ndp)
@@ -261,6 +279,7 @@ def batch_show_verify_sharded(
     """dp-sharded batched PoKOfSignatureProof.verify on a mesh: [B] bools,
     bit-identical to `JaxBackend.batch_show_verify` (reference surface
     pok_sig.rs:103-105). The proof batch must divide the dp extent."""
+    require_axes(mesh, batch_axis)
     ndp = mesh.shape[batch_axis]
     if len(proofs) % ndp:
         raise ValueError(
@@ -289,21 +308,30 @@ def batch_verify_sharded_async(
     reference's per-credential verdict semantics, signature.rs:472-478):
     dispatches the sharded fused program and returns a zero-arg finalizer
     so `stream.verify_stream(mode='per_credential', mesh=...)` can keep
-    the mesh busy across the readback round trip."""
+    the mesh busy across the readback round trip.
+
+    The final batch of a stream rarely divides the dp extent; it is padded
+    by repeating the last credential up to the next multiple and the
+    verdict bits are sliced back to the true length, so callers never see
+    the padding (a duplicated real credential re-verifies to the same bit;
+    verdicts are per-lane, so pad lanes cannot affect real ones)."""
+    require_axes(mesh, batch_axis, msm_axis)
     ndp = mesh.shape[batch_axis]
     ntp = mesh.shape[msm_axis]  # the sharded program requires both axes
-    if len(sigs) % ndp:
-        raise ValueError(
-            "batch size %d not divisible by %s=%d"
-            % (len(sigs), batch_axis, ndp)
-        )
+    B = len(sigs)
+    if B == 0:
+        return lambda: []
+    pad = (-B) % ndp
+    if pad:
+        sigs = list(sigs) + [sigs[-1]] * pad
+        messages_list = list(messages_list) + [messages_list[-1]] * pad
     k = 1 + len(vk.Y_tilde)
     operands = backend.encode_verify_batch(
         sigs, messages_list, vk, params, pad_bases_to=pad_to_multiple(k, ntp)
     )
     fn = make_sharded_verify(mesh, params.ctx.name == "G1", batch_axis, msm_axis)
     bits = fn(*operands)
-    return lambda: [bool(b) for b in np.asarray(bits)]
+    return lambda: [bool(b) for b in np.asarray(bits)[:B]]
 
 
 # --- sharded issuance (config 4 on a mesh) ----------------------------------
@@ -383,6 +411,7 @@ class ShardedIssuanceBackend(bk.JaxBackend):
     name = "jax_sharded_issuance"
 
     def __init__(self, mesh, batch_axis="dp"):
+        require_axes(mesh, batch_axis)
         self.mesh = mesh
         self.batch_axis = batch_axis
 

@@ -44,3 +44,8 @@ def pytest_configure(config):
         "heavy: multi-minute at-scale fused-kernel tests, run by ci.sh's "
         "separate heavy-lane process (COCONUT_TEST_HEAVY=1, -m heavy)",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-supervision suite (retry/fallback/bisection/"
+        "checkpoint hardening), also run explicitly by ci.sh's fault lane",
+    )

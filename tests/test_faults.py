@@ -1,0 +1,820 @@
+"""Fault-supervision suite (ISSUE 2): retry/backoff, backend fallback,
+grouped-failure bisection, dead-lettering, and checkpoint hardening, all
+driven by the deterministic `coconut_tpu.faults.FaultyBackend` injector.
+
+Economics: the tier-1 budget is tight, so nearly everything here runs on
+stub backends (SimpleNamespace credentials carrying their own verdict);
+real BLS crypto appears only in the handful of acceptance tests that the
+ISSUE pins to real verification. All retry policies use base_delay=0 or an
+injected no-op sleep — the suite never sleeps."""
+
+import json
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from coconut_tpu import metrics
+from coconut_tpu.errors import (
+    CheckpointCorruptError,
+    CheckpointMismatchError,
+    TransientBackendError,
+)
+from coconut_tpu.faults import DeadLetterLog, FaultyBackend
+from coconut_tpu.retry import RetryPolicy, call_with_retry, note_attempt
+from coconut_tpu.stream import (
+    STATE_SCHEMA_VERSION,
+    StreamState,
+    run_fingerprint,
+    verify_stream,
+)
+
+pytestmark = pytest.mark.faults
+
+
+# --- stub world: credentials that carry their own verdict ------------------
+
+
+def _cred(ok=True):
+    # sigma fields non-None so the drivers' identity-signature guards pass
+    return SimpleNamespace(sigma_1=1, sigma_2=1, ok=ok)
+
+
+def _stub_source(n_batches, per_batch=3, forged=()):
+    """source(i) -> (sigs, msgs) of stub credentials; forged is a set of
+    (batch, index-in-batch) pairs whose credential verdicts are False."""
+    forged = set(forged)
+
+    def source(i):
+        sigs = [_cred(ok=(i, j) not in forged) for j in range(per_batch)]
+        return sigs, [[0, 0] for _ in range(per_batch)]
+
+    return source
+
+
+class StubPerCred:
+    def batch_verify(self, sigs, msgs, vk, params):
+        return [bool(s.ok) for s in sigs]
+
+
+class StubGrouped:
+    def batch_verify_grouped(self, sigs, msgs, vk, params):
+        return all(s.ok for s in sigs)
+
+
+class StubAsync:
+    def batch_verify_async(self, sigs, msgs, vk, params):
+        bits = [bool(s.ok) for s in sigs]
+        return lambda: bits
+
+
+def _policy(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("base_delay", 0.0)
+    return RetryPolicy(**kw)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# --- RetryPolicy / call_with_retry unit behavior ---------------------------
+
+
+def test_backoff_deterministic_bounded_and_desynced():
+    p = RetryPolicy(base_delay=0.1, max_delay=0.35, jitter=0.5)
+    for attempt in (1, 2, 3, 4):
+        for key in (0, 1, 7):
+            d = p.backoff(attempt, key=key)
+            raw = min(0.35, 0.1 * 2.0 ** (attempt - 1))
+            assert 0.5 * raw <= d <= raw
+            assert d == p.backoff(attempt, key=key)  # pure
+    # distinct batches desynchronize their re-dispatch times
+    assert p.backoff(1, key=0) != p.backoff(1, key=1)
+
+
+def test_policy_validates_configuration():
+    with pytest.raises(ValueError):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError):
+        RetryPolicy(jitter=1.5)
+    with pytest.raises(ValueError):
+        RetryPolicy(base_delay=-1)
+
+
+def test_call_with_retry_recovers_and_counts():
+    boom = [2]
+
+    def fn():
+        if boom[0]:
+            boom[0] -= 1
+            raise TransientBackendError("flaky")
+        return 42
+
+    attempts = []
+    slept = []
+    p = _policy(sleep=slept.append)
+    assert call_with_retry(fn, p, key=5, attempts=attempts) == 42
+    assert metrics.get_count("retries") == 2
+    assert len(slept) == 2
+    assert [a["attempt"] for a in attempts] == [1, 2]
+    assert attempts[0]["error"] == "TransientBackendError"
+    assert "flaky" in attempts[0]["detail"]
+
+
+def test_call_with_retry_exhaustion_reraises_without_fallback():
+    def fn():
+        raise TransientBackendError("always")
+
+    with pytest.raises(TransientBackendError):
+        call_with_retry(fn, _policy())
+    assert metrics.get_count("retries") == 2  # attempts 2 and 3
+    assert metrics.get_count("fallbacks") == 0
+
+
+def test_call_with_retry_exhaustion_runs_fallback():
+    def fn():
+        raise TransientBackendError("always")
+
+    assert call_with_retry(fn, _policy(), fallback=lambda: "degraded") == (
+        "degraded"
+    )
+    assert metrics.get_count("fallbacks") == 1
+
+
+def test_permanent_error_is_not_retried():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise RuntimeError("permanent")
+
+    with pytest.raises(RuntimeError):
+        call_with_retry(fn, _policy())
+    assert len(calls) == 1
+    assert metrics.get_count("retries") == 0
+
+
+def test_preconsumed_attempts_raise_synthetic_transient():
+    attempts = []
+    for _ in range(3):
+        note_attempt(attempts, TransientBackendError("eager"))
+    with pytest.raises(TransientBackendError, match="retries exhausted"):
+        call_with_retry(lambda: 1, _policy(), attempts=attempts)
+
+
+# --- FaultyBackend injector ------------------------------------------------
+
+
+def test_faulty_backend_is_capability_transparent():
+    faulty = FaultyBackend(StubPerCred())
+    assert hasattr(faulty, "batch_verify")
+    assert not hasattr(faulty, "batch_verify_grouped")
+    assert not hasattr(faulty, "batch_verify_async")
+
+
+def test_faulty_backend_raise_every_schedule():
+    faulty = FaultyBackend(StubPerCred(), raise_every=3)
+    seen = []
+    for i in range(6):
+        try:
+            faulty.batch_verify([_cred()], [[0]], None, None)
+            seen.append("ok")
+        except TransientBackendError:
+            seen.append("boom")
+    assert seen == ["ok", "ok", "boom", "ok", "ok", "boom"]
+    assert faulty.dispatches == 6
+
+
+def test_faulty_backend_flips_verdicts():
+    faulty = FaultyBackend(StubGrouped(), flip_on={0})
+    sigs = [_cred(), _cred()]
+    assert faulty.batch_verify_grouped(sigs, [[0], [0]], None, None) is False
+    assert faulty.batch_verify_grouped(sigs, [[0], [0]], None, None) is True
+
+
+def test_faulty_backend_corrupts_async_finalizer():
+    faulty = FaultyBackend(StubAsync(), corrupt_finalizer_on={0})
+    fin = faulty.batch_verify_async([_cred()], [[0]], None, None)
+    with pytest.raises(TransientBackendError, match="finalizer fault"):
+        fin()
+    fin2 = faulty.batch_verify_async([_cred()], [[0]], None, None)
+    assert fin2() == [True]
+
+
+# --- supervised verify_stream: retry + fallback ----------------------------
+
+
+def test_stream_retries_through_transient_faults_stub():
+    """Every 3rd dispatch raises; the retry ladder absorbs each fault and
+    the 20-batch stream completes with exact tallies."""
+    faulty = FaultyBackend(StubPerCred(), raise_every=3)
+    state = verify_stream(
+        _stub_source(20, forged={(4, 1)}),
+        20,
+        None,
+        None,
+        faulty,
+        mode="per_credential",
+        retry_policy=_policy(),
+    )
+    assert state.next_batch == 20
+    assert state.verified + state.failed == 60
+    assert state.failed == 1
+    assert metrics.get_count("retries") > 0
+    assert metrics.get_count("fallbacks") == 0
+
+
+def test_stream_exhaustion_falls_back_per_batch():
+    """A backend that ALWAYS raises: every batch exhausts its attempts and
+    re-dispatches on the fallback; the stream still completes exactly."""
+
+    class AlwaysDown:
+        def batch_verify(self, sigs, msgs, vk, params):
+            raise TransientBackendError("device gone")
+
+    state = verify_stream(
+        _stub_source(5),
+        5,
+        None,
+        None,
+        AlwaysDown(),
+        mode="per_credential",
+        retry_policy=_policy(max_attempts=2),
+        fallback_backend=StubPerCred(),
+    )
+    assert state.verified == 15 and state.failed == 0
+    assert metrics.get_count("fallbacks") == 5
+    assert metrics.get_count("retries") == 5  # one re-attempt per batch
+
+
+def test_stream_no_fallback_propagates_and_checkpoint_resumes(tmp_path):
+    """Without a fallback, exhaustion propagates; the checkpoint preserves
+    the completed prefix, and a rerun against a healed backend finishes
+    with exact totals."""
+    path = str(tmp_path / "state.json")
+    source = _stub_source(4)
+
+    class DiesOnBatch2:
+        def __init__(self):
+            self.calls = 0
+
+        def batch_verify(self, sigs, msgs, vk, params):
+            if self.calls == 2:
+                raise TransientBackendError("stuck")
+            self.calls += 1
+            return [bool(s.ok) for s in sigs]
+
+    with pytest.raises(TransientBackendError):
+        verify_stream(
+            source, 4, None, None, DiesOnBatch2(),
+            state_path=path, retry_policy=_policy(max_attempts=1),
+        )
+    st = StreamState(path)
+    assert st.next_batch == 2 and st.verified == 6
+    state = verify_stream(
+        source, 4, None, None, StubPerCred(), state_path=path
+    )
+    assert state.next_batch == 4 and state.verified == 12
+
+
+def test_stream_retries_corrupted_async_finalizer():
+    """A readback (finalizer) fault re-runs the full dispatch+readback
+    cycle — the pipelined seam, not just the sync one."""
+    faulty = FaultyBackend(StubAsync(), corrupt_finalizer_on={1})
+    state = verify_stream(
+        _stub_source(4),
+        4,
+        None,
+        None,
+        faulty,
+        retry_policy=_policy(),
+        pipeline_depth=2,
+    )
+    assert state.verified == 12 and state.failed == 0
+    assert metrics.get_count("retries") == 1
+
+
+def test_stream_default_policy_keeps_old_error_behavior():
+    """No retry_policy and no fallback: a dispatch error propagates
+    exactly as before the supervision layer existed."""
+
+    class Dies:
+        def batch_verify(self, sigs, msgs, vk, params):
+            raise TransientBackendError("boom")
+
+    with pytest.raises(TransientBackendError):
+        verify_stream(_stub_source(2), 2, None, None, Dies())
+    assert metrics.get_count("retries") == 0
+
+
+def test_stream_flipped_verdict_is_not_a_crash():
+    """A miscompute (flipped verdict) is NOT an exception: supervision
+    does not mask it, the tallies record it."""
+    faulty = FaultyBackend(StubPerCred(), flip_on={2})
+    state = verify_stream(
+        _stub_source(4), 4, None, None, faulty, retry_policy=_policy()
+    )
+    assert state.failed == 3  # batch 2's three verdicts negated
+    assert state.verified == 9
+
+
+# --- grouped-failure bisection + dead-letter -------------------------------
+
+
+def test_grouped_bisection_isolates_single_culprit(tmp_path):
+    dlq = str(tmp_path / "dead.jsonl")
+    state = verify_stream(
+        _stub_source(4, per_batch=8, forged={(2, 5)}),
+        4,
+        None,
+        None,
+        StubGrouped(),
+        mode="grouped",
+        dead_letter_path=dlq,
+    )
+    assert state.batches_ok == 3 and state.batches_failed == 1
+    # granular accounting: only the culprit fails, not the whole batch
+    assert state.failed == 1 and state.verified == 31
+    assert metrics.get_count("bisections") > 0
+    assert metrics.get_count("dead_letters") == 1
+    (rec,) = DeadLetterLog.read(dlq)
+    assert rec["batch"] == 2 and rec["credential"] == 5
+    assert "bisection" in rec["reason"]
+    assert rec["attempts"] == []
+
+
+def test_grouped_bisection_multiple_culprits(tmp_path):
+    dlq = str(tmp_path / "dead.jsonl")
+    forged = {(1, 0), (1, 3), (1, 7)}
+    state = verify_stream(
+        _stub_source(2, per_batch=8, forged=forged),
+        2,
+        None,
+        None,
+        StubGrouped(),
+        mode="grouped",
+        dead_letter_path=dlq,
+    )
+    assert state.failed == 3 and state.verified == 13
+    recs = DeadLetterLog.read(dlq)
+    assert sorted(r["credential"] for r in recs) == [0, 3, 7]
+    assert all(r["batch"] == 1 for r in recs)
+
+
+def test_grouped_without_dead_letter_keeps_wholesale_accounting():
+    """No dead_letter_path -> bisection stays off by default and a
+    rejected batch counts wholesale, exactly the pre-existing grouped
+    semantics."""
+    state = verify_stream(
+        _stub_source(3, forged={(1, 2)}),
+        3,
+        None,
+        None,
+        StubGrouped(),
+        mode="grouped",
+    )
+    assert state.batches_failed == 1
+    assert state.failed == 3 and state.verified == 6
+    assert metrics.get_count("bisections") == 0
+
+
+def test_bisect_failures_forced_on_without_dead_letter(tmp_path):
+    """bisect_failures=True without a dead-letter path: granular
+    accounting, no file written."""
+    state = verify_stream(
+        _stub_source(3, per_batch=4, forged={(0, 1)}),
+        3,
+        None,
+        None,
+        StubGrouped(),
+        mode="grouped",
+        bisect_failures=True,
+    )
+    assert state.failed == 1 and state.verified == 11
+    assert metrics.get_count("dead_letters") == 0
+
+
+def test_bisection_probes_ride_the_retry_ladder(tmp_path):
+    """Bisection probes hitting injected transient faults are retried with
+    the same policy as regular dispatches."""
+    dlq = str(tmp_path / "dead.jsonl")
+    faulty = FaultyBackend(StubGrouped(), raise_every=4)
+    state = verify_stream(
+        _stub_source(3, per_batch=8, forged={(1, 6)}),
+        3,
+        None,
+        None,
+        faulty,
+        mode="grouped",
+        retry_policy=_policy(),
+        dead_letter_path=dlq,
+    )
+    assert state.failed == 1 and state.verified == 23
+    (rec,) = DeadLetterLog.read(dlq)
+    assert rec["batch"] == 1 and rec["credential"] == 6
+    assert metrics.get_count("retries") > 0
+
+
+def test_dead_letter_log_roundtrip(tmp_path):
+    path = str(tmp_path / "d.jsonl")
+    log = DeadLetterLog(path)
+    log.append(batch=3, credential=1, reason="r", attempts=[{"attempt": 1}])
+    log.append(batch=4, credential=0, reason="s")
+    with open(path) as f:
+        lines = f.read().splitlines()
+    assert len(lines) == 2
+    first = json.loads(lines[0])
+    assert first == {
+        "attempts": [{"attempt": 1}],
+        "batch": 3,
+        "credential": 1,
+        "reason": "r",
+    }
+    assert DeadLetterLog.read(path)[1]["batch"] == 4
+    assert DeadLetterLog.read(str(tmp_path / "missing.jsonl")) == []
+
+
+# --- checkpoint hardening --------------------------------------------------
+
+
+def _run_then_state(tmp_path, n=3):
+    path = str(tmp_path / "state.json")
+    verify_stream(
+        _stub_source(n), n, None, None, StubPerCred(), state_path=path
+    )
+    return path
+
+
+def test_state_file_carries_schema_crc_fingerprint(tmp_path):
+    path = _run_then_state(tmp_path)
+    doc = json.load(open(path))
+    assert doc["schema"] == STATE_SCHEMA_VERSION
+    assert isinstance(doc["crc32"], int)
+    assert doc["payload"]["fingerprint"] == run_fingerprint(
+        "per_credential", None, None
+    )
+    assert doc["payload"]["next_batch"] == 3
+
+
+def test_truncated_checkpoint_quarantined_and_rerun_completes(tmp_path):
+    path = _run_then_state(tmp_path, n=3)
+    raw = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(raw[: len(raw) // 2])  # truncate mid-JSON
+    state = verify_stream(
+        _stub_source(3), 3, None, None, StubPerCred(), state_path=path
+    )
+    # rerun started clean and re-verified everything, exactly
+    assert state.next_batch == 3 and state.verified == 9
+    assert state.quarantined and state.quarantined.endswith(".corrupt")
+    assert open(state.quarantined, "rb").read() == raw[: len(raw) // 2]
+    assert metrics.get_count("checkpoint_quarantined") == 1
+    # the fresh checkpoint written by the rerun is valid again
+    assert StreamState(path).next_batch == 3
+
+
+def test_wrong_schema_version_quarantined(tmp_path):
+    path = _run_then_state(tmp_path)
+    doc = json.load(open(path))
+    doc["schema"] = 99
+    json.dump(doc, open(path, "w"))
+    st = StreamState(path)
+    assert st.next_batch == 0 and st.quarantined
+    assert metrics.get_count("checkpoint_quarantined") == 1
+
+
+def test_crc_tamper_quarantined(tmp_path):
+    path = _run_then_state(tmp_path)
+    doc = json.load(open(path))
+    doc["payload"]["verified"] += 1  # bit-flip the tallies
+    json.dump(doc, open(path, "w"))
+    st = StreamState(path)
+    assert st.next_batch == 0 and st.quarantined
+    assert metrics.get_count("checkpoint_quarantined") == 1
+
+
+def test_quarantine_never_overwrites_earlier_quarantine(tmp_path):
+    path = str(tmp_path / "s.json")
+    for expect in (".corrupt", ".corrupt-1"):
+        with open(path, "w") as f:
+            f.write("not json")
+        st = StreamState(path)
+        assert st.quarantined.endswith(expect)
+
+
+def test_fingerprint_mismatch_fails_loudly(tmp_path):
+    path = _run_then_state(tmp_path)  # per_credential run
+    with pytest.raises(CheckpointMismatchError) as ei:
+        verify_stream(
+            _stub_source(3), 3, None, None, StubGrouped(),
+            state_path=path, mode="grouped",
+        )
+    assert ei.value.stored == run_fingerprint("per_credential", None, None)
+    assert ei.value.expected == run_fingerprint("grouped", None, None)
+    # the file is intact — mismatch must not quarantine or clobber
+    assert StreamState(path).next_batch == 3
+
+
+def test_stored_fingerprint_none_is_accepted(tmp_path):
+    """A checkpoint written without a fingerprint (direct StreamState use,
+    e.g. pre-supervision callers) resumes fine under a fingerprinted
+    run."""
+    path = str(tmp_path / "s.json")
+    st = StreamState(path)
+    st.next_batch = 1
+    st.verified = 3
+    st.save()
+    state = verify_stream(
+        _stub_source(3), 3, None, None, StubPerCred(), state_path=path
+    )
+    assert state.next_batch == 3 and state.verified == 9
+
+
+def test_legacy_v1_checkpoint_quarantined_not_crashed(tmp_path):
+    """A pre-hardening (schema-less flat JSON) state file is treated as an
+    unknown schema: quarantined, stream restarts from zero."""
+    path = str(tmp_path / "s.json")
+    json.dump({"next_batch": 2, "verified": 6, "failed": 0}, open(path, "w"))
+    st = StreamState(path)
+    assert st.next_batch == 0 and st.quarantined
+
+
+def test_mid_on_batch_crash_replays_batch_at_least_once(tmp_path):
+    """on_batch runs BEFORE the checkpoint write: a crash inside it means
+    the batch replays on resume (at-least-once) and tallies stay exact."""
+    path = str(tmp_path / "s.json")
+    delivered = []
+    crashed = []
+
+    def exploding_on_batch(i, bits):
+        if i == 1 and not crashed:
+            crashed.append(True)
+            raise RuntimeError("killed mid-delivery")
+        delivered.append(i)
+
+    with pytest.raises(RuntimeError, match="mid-delivery"):
+        verify_stream(
+            _stub_source(3), 3, None, None, StubPerCred(),
+            state_path=path, on_batch=exploding_on_batch,
+        )
+    assert StreamState(path).next_batch == 1  # batch 1 not checkpointed
+    state = verify_stream(
+        _stub_source(3), 3, None, None, StubPerCred(),
+        state_path=path, on_batch=exploding_on_batch,
+    )
+    assert delivered == [0, 1, 2]  # batch 1 replayed, none lost
+    assert state.verified == 9 and state.next_batch == 3
+
+
+def test_checkpoint_corrupt_error_is_typed():
+    with pytest.raises(CheckpointCorruptError):
+        StreamState._load_checked("/nonexistent/state.json")
+
+
+# --- acceptance: real crypto under injected faults -------------------------
+
+
+def _real_setup():
+    from coconut_tpu.ops.curve import G1_GEN, G2_GEN
+    from coconut_tpu.ops.fields import R
+    from coconut_tpu.params import Params, SIGNATURES_IN_G1
+    from coconut_tpu.signature import Sigkey, Verkey
+
+    rng = random.Random(0xFA171)
+    ctx = SIGNATURES_IN_G1
+    g = ctx.sig.mul(G1_GEN, rng.randrange(1, R))
+    g_tilde = ctx.other.mul(G2_GEN, rng.randrange(1, R))
+    h = [ctx.sig.mul(G1_GEN, rng.randrange(1, R)) for _ in range(2)]
+    params = Params(g, g_tilde, h, ctx)
+    sk = Sigkey(rng.randrange(1, R), [rng.randrange(1, R) for _ in range(2)])
+    vk = Verkey(
+        ctx.other.mul(g_tilde, sk.x),
+        [ctx.other.mul(g_tilde, y) for y in sk.y],
+    )
+    return rng, params, sk, vk
+
+
+def _real_source(rng, params, sk, per_batch, corrupt_at=None):
+    from coconut_tpu.ops.fields import R
+    from coconut_tpu.signature import Signature
+
+    def source(i):
+        sigs, msgs_list = [], []
+        for j in range(per_batch):
+            msgs = [rng.randrange(R) for _ in range(2)]
+            t = rng.randrange(1, R)
+            s1 = params.ctx.sig.mul(params.g, t)
+            expo = (sk.x + sum(y * m for y, m in zip(sk.y, msgs))) % R
+            s2 = params.ctx.sig.mul(s1, expo)
+            if corrupt_at == (i, j):
+                s2 = params.ctx.sig.mul(s2, 2)
+            sigs.append(Signature(s1, s2))
+            msgs_list.append(msgs)
+        return sigs, msgs_list
+
+    return source
+
+
+def test_acceptance_real_stream_survives_every_3rd_dispatch_fault():
+    """ISSUE acceptance: injected transient fault on every 3rd dispatch,
+    20-batch real-crypto stream completes with exact tallies and nonzero
+    retries in metrics.snapshot()."""
+    from coconut_tpu.backend import PythonBackend
+
+    rng, params, sk, vk = _real_setup()
+    source = _real_source(rng, params, sk, per_batch=2, corrupt_at=(7, 1))
+    faulty = FaultyBackend(PythonBackend(), raise_every=3)
+    state = verify_stream(
+        source, 20, vk, params, faulty, retry_policy=_policy()
+    )
+    assert state.next_batch == 20
+    assert state.verified + state.failed == 40
+    assert state.failed == 1
+    snap = metrics.snapshot()["counters"]
+    assert snap["retries"] > 0
+    assert snap.get("fallbacks", 0) == 0
+
+
+def test_acceptance_real_grouped_bisection_dead_letters_forgery(tmp_path):
+    """ISSUE acceptance: a grouped batch with exactly one forged
+    credential yields a dead-letter entry naming that credential's index
+    via bisection, under real PS verification."""
+    from coconut_tpu.ps import ps_verify
+
+    rng, params, sk, vk = _real_setup()
+    source = _real_source(rng, params, sk, per_batch=4, corrupt_at=(1, 2))
+
+    class GroupedPy:
+        def batch_verify_grouped(self, s, m, v, p):
+            return all(ps_verify(si, mi, v, p) for si, mi in zip(s, m))
+
+    dlq = str(tmp_path / "dead.jsonl")
+    state = verify_stream(
+        source, 3, vk, params, GroupedPy(),
+        mode="grouped", dead_letter_path=dlq,
+    )
+    assert state.batches_failed == 1 and state.failed == 1
+    assert state.verified == 11
+    (rec,) = DeadLetterLog.read(dlq)
+    assert rec["batch"] == 1 and rec["credential"] == 2
+    assert metrics.get_count("bisections") > 0
+
+
+def test_acceptance_fallback_backend_by_name():
+    """fallback_backend='python' resolves through the registry; an
+    always-down primary degrades onto real reference verification."""
+
+    class AlwaysDown:
+        def batch_verify(self, sigs, msgs, vk, params):
+            raise TransientBackendError("down")
+
+    rng, params, sk, vk = _real_setup()
+    source = _real_source(rng, params, sk, per_batch=2)
+    state = verify_stream(
+        source, 2, vk, params, AlwaysDown(),
+        retry_policy=_policy(max_attempts=2),
+        fallback_backend="python",
+    )
+    assert state.verified == 4 and state.failed == 0
+    assert metrics.get_count("fallbacks") == 2
+
+
+# --- satellite: mesh axis validation + final-batch padding -----------------
+
+
+def test_require_axes_clear_error():
+    from coconut_tpu.tpu import shard
+
+    mesh = SimpleNamespace(shape={"data": 8})
+    with pytest.raises(ValueError, match="missing axis"):
+        shard.require_axes(mesh, "dp", "tp")
+    shard.require_axes(mesh, "data")  # present axis passes
+
+
+def test_stream_mesh_missing_axis_is_clear_valueerror():
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    from coconut_tpu.tpu import shard
+
+    mesh = Mesh(np.array(jax.devices()[:1]).reshape(1), ("data",))
+
+    class MeshStub:
+        # attribute presence is all _dispatchers probes before axes
+        encode_verify_batch = staticmethod(lambda *a, **k: ())
+        encode_grouped_batch = staticmethod(lambda *a, **k: ())
+
+    with pytest.raises(ValueError, match="missing axis"):
+        verify_stream(
+            _stub_source(1), 1, None, None, MeshStub(), mesh=mesh
+        )
+    with pytest.raises(ValueError, match="missing axis"):
+        verify_stream(
+            _stub_source(1), 1, None, None, MeshStub(),
+            mesh=mesh, mode="grouped",
+        )
+
+
+def test_sharded_percred_pads_final_batch(monkeypatch):
+    """batch_verify_sharded_async pads a non-divisible final batch by
+    repeating the last credential and slices the bits back to len(sigs)."""
+    import numpy as np
+
+    from coconut_tpu.tpu import shard
+
+    mesh = SimpleNamespace(shape={"dp": 4, "tp": 1})
+    seen = {}
+
+    class EncBackend:
+        def encode_verify_batch(self, sigs, msgs, vk, params, **kw):
+            seen["n"] = len(sigs)
+            seen["last_two_same"] = sigs[-1] is sigs[-2]
+            return (len(sigs),)
+
+    def fake_make(mesh_, g1, ba, ma):
+        return lambda n: np.ones(n, dtype=bool)
+
+    monkeypatch.setattr(shard, "make_sharded_verify", fake_make)
+    vk = SimpleNamespace(Y_tilde=[1, 2])
+    params = SimpleNamespace(ctx=SimpleNamespace(name="G1"))
+    sigs = [_cred() for _ in range(6)]
+    fin = shard.batch_verify_sharded_async(
+        EncBackend(), sigs, [[0]] * 6, vk, params, mesh
+    )
+    assert seen["n"] == 8  # padded 6 -> 8 (next multiple of dp=4)
+    assert seen["last_two_same"]  # pad repeats the final credential
+    assert fin() == [True] * 6  # sliced back to the true length
+    # empty batch short-circuits without touching the mesh
+    assert shard.batch_verify_sharded_async(
+        EncBackend(), [], [], vk, params, mesh
+    )() == []
+
+
+# --- satellite: COCONUT_PALLAS_KARATSUBA parse -----------------------------
+
+
+def test_parse_karatsuba_matrix():
+    from coconut_tpu.tpu.pallas_fp import _parse_karatsuba
+
+    for raw in (None, "", "  ", "banana", "-1", "1.5"):
+        assert _parse_karatsuba(raw) == 2
+    assert _parse_karatsuba("0") == 0
+    assert _parse_karatsuba("1") == 1
+    assert _parse_karatsuba(" 2 ") == 2
+    with pytest.raises(ValueError, match="at most two levels"):
+        _parse_karatsuba("3")
+
+
+# --- satellite: COCONUT_DEBUG_PACK host-side assert ------------------------
+
+
+def test_pack_debug_records_and_asserts_at_decode():
+    import numpy as np
+
+    from coconut_tpu.tpu import limbs
+
+    del limbs.PACK_DEBUG_VIOLATIONS[:]
+    limbs.pack_debug_record(np.float32(100.0))  # within bound: ignored
+    limbs.pack_debug_check()  # no violation, no raise
+    limbs.pack_debug_record(np.float32(500.0))
+    with pytest.raises(AssertionError, match="pack bound 396"):
+        limbs.fp_decode_batch(
+            np.zeros((1, limbs.NLIMBS), dtype=np.float32)
+        )
+    # the check drained the buffer: decoding works again
+    assert limbs.fp_decode_batch(
+        np.zeros((1, limbs.NLIMBS), dtype=np.float32)
+    ) == [0]
+
+
+def test_pack_debug_callback_records_under_jit(monkeypatch):
+    """The COCONUT_DEBUG_PACK=1 branch of _pack_pt records the limb max
+    through jax.debug.callback without raising inside the jitted program;
+    an in-bound pack leaves the buffer empty."""
+    import jax
+    import jax.numpy as jnp
+
+    from coconut_tpu.tpu import backend as bk
+    from coconut_tpu.tpu import limbs
+
+    monkeypatch.setenv("COCONUT_DEBUG_PACK", "1")
+    del limbs.PACK_DEBUG_VIOLATIONS[:]
+
+    @jax.jit
+    def prog(x, y):
+        return bk._pack_pt(x, y)
+
+    x = jnp.zeros((1, limbs.NLIMBS), dtype=jnp.float32)
+    jax.block_until_ready(prog(x, x))
+    limbs.pack_debug_check()  # in-bound: nothing recorded
+
+    y = jnp.full((1, limbs.NLIMBS), 500.0, dtype=jnp.float32)
+    jax.block_until_ready(prog(x, y))
+    jax.effects_barrier()
+    with pytest.raises(AssertionError, match="pack bound 396"):
+        limbs.pack_debug_check()
