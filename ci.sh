@@ -49,6 +49,18 @@ grep -q '"credential": 2' "$DLQ"
 grep -q '"reason"' "$DLQ"
 grep -q '"attempts"' "$DLQ"
 echo "dead-letter schema: ok"
+
+echo "== encode-pipeline lane (prefetch worker / static cache / raw wire) =="
+# lean by construction: only host-side / small-jit tests carry the
+# `pipeline` marker (the kernel-materializing encode tests ride the
+# default suite above, the sharded pad regression the heavy lane) — so
+# this lane stays minutes, not the multi-minute-per-shape trace cost
+python -m pytest tests/ -m pipeline -q
+# per-stage encode micro-probe (bytes-framing vs digits vs tables): the
+# profiling-round artifact for where the host encode wall actually is.
+# Host-encode stages are platform-independent — pin CPU so the probe
+# never pays a tunneled comb build in the default lane.
+JAX_PLATFORMS=cpu python probes/probe_encode.py
 if [ "${CI_HEAVY:-0}" = "1" ]; then
   # Heavy lane in its OWN process: the at-scale B=1024 programs
   # accumulate ~25 GB of compiled XLA CPU state, and one combined

@@ -15,6 +15,14 @@ exhausted), "bisections" (grouped-failure splits while isolating culprit
 credentials), "dead_letters" (culprits appended to the dead-letter JSONL),
 and "checkpoint_quarantined" (corrupt state files moved aside on resume).
 
+The encode pipeline reports here too: "encode_cache_hits" /
+"encode_cache_misses" (the backend's static-operand cache — comb tables,
+grouped point uploads, g_tilde — see tpu/backend._static_operands),
+"prefetched_batches" (batches encoded+dispatched by verify_stream's
+background worker), and the "prefetch_wait" timer (main-thread seconds
+blocked waiting on the prefetch queue: near zero means the encode worker
+keeps the device fed — pipeline occupancy is 1 - prefetch_wait/wall).
+
 Zero-cost when unused: plain dicts, no background threads, no deps.
 Device-side profiling is separate: the hot kernels in tpu/backend.py carry
 `jax.named_scope` annotations (comb_msm, grouped_tables /

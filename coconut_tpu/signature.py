@@ -620,14 +620,16 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
     from . import native as _native
 
     hash_native = ctx.name == "G1" and _native.available()
-    hs = []
-    for c, known in zip(commitments, known_lists):
-        data = ctx.sig_to_bytes(c) + b"".join(
-            ser.fr_to_bytes(m) for m in known
-        )
-        hs.append(
-            _native.hash_to_g1(data) if hash_native else ctx.hash_to_sig(data)
-        )
+    datas = [
+        ctx.sig_to_bytes(c) + b"".join(ser.fr_to_bytes(m) for m in known)
+        for c, known in zip(commitments, known_lists)
+    ]
+    if hash_native:
+        # one FFI round trip for the whole batch (1,024 serial per-call
+        # hashes were the prepare phase's host wall — PROFILE_r05)
+        hs = _native.hash_to_g1_batch(datas)
+    else:
+        hs = [ctx.hash_to_sig(d) for d in datas]
 
     # the per-request h^{m_ij} terms need h, which needs the commitment
     # hash — an unavoidable host round trip between the two programs

@@ -408,6 +408,75 @@ def _make_bisector(
     return bisect
 
 
+def _prefetch_launches(produce, depth):
+    """Run `produce()` — a generator yielding launched batches — on a
+    background worker thread, buffering at most `depth` items in a bounded
+    queue: batch i+1 (and i+2, ...) encodes and dispatches while the main
+    thread blocks on batch i's readback (the blocking wait releases the
+    GIL, so the host-side encode genuinely overlaps it).
+
+    Yields items in production order (the queue is FIFO, so the settle
+    order and checkpoint sequence are identical to the serial path). A
+    producer exception is re-raised here at the point of consumption —
+    matching the serial path, where a non-retryable launch error
+    propagates before later batches run. When the consumer abandons the
+    generator (e.g. a settle raised), the worker is told to stop and the
+    queue drained so a blocked put can finish.
+
+    Observability: the "prefetch_wait" timer accumulates main-thread
+    seconds blocked on the queue (near zero = the worker keeps the device
+    fed) and "prefetched_batches" counts deliveries."""
+    import queue as queue_mod
+    import threading
+
+    from . import metrics
+
+    q = queue_mod.Queue(maxsize=depth)
+    stop = threading.Event()
+    done = object()
+
+    def _put(item):
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return
+            except queue_mod.Full:
+                continue
+
+    def work():
+        try:
+            for item in produce():
+                if stop.is_set():
+                    return
+                _put((None, item))
+            _put((None, done))
+        except BaseException as e:  # re-raised on the consuming thread
+            _put((e, None))
+
+    t = threading.Thread(
+        target=work, name="coconut-encode-prefetch", daemon=True
+    )
+    t.start()
+    try:
+        while True:
+            with metrics.timer("prefetch_wait"):
+                exc, item = q.get()
+            if exc is not None:
+                raise exc
+            if item is done:
+                return
+            metrics.count("prefetched_batches")
+            yield item
+    finally:
+        stop.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue_mod.Empty:
+            pass
+        t.join(timeout=5.0)
+
+
 def verify_stream(
     source,
     n_batches,
@@ -420,6 +489,7 @@ def verify_stream(
     pipeline=True,
     mesh=None,
     pipeline_depth=3,
+    prefetch_depth=2,
     retry_policy=None,
     fallback_backend=None,
     dead_letter_path=None,
@@ -440,8 +510,16 @@ def verify_stream(
     2,520 -> 4,416 -> ~4,700 creds/s at depths 1/3/4 against the ~4,875/s
     device-time ceiling). Checkpoint lag is bounded by the depth: a crash
     re-runs at most `pipeline_depth` batches (at-least-once delivery, same
-    as depth 1). `mesh` dp-shards the grouped mode over a jax Mesh
-    (multi-chip config 5).
+    as depth 1). `prefetch_depth` (when pipelining) moves `source(i)` and
+    the host encode+dispatch onto a bounded background worker so batch
+    i+1 encodes while the main thread blocks on batch i's readback —
+    see _prefetch_launches; 0 disables the worker (encode stays on the
+    calling thread, still overlapped with device execution by async
+    dispatch alone). Checkpoint-lag and delivery semantics are unchanged:
+    the worker only ENCODES ahead; settle order, retry accounting, and
+    checkpoint writes stay on the calling thread, so a crash still re-runs
+    at most `pipeline_depth` batches. `mesh` dp-shards the grouped mode
+    over a jax Mesh (multi-chip config 5).
 
     Fault tolerance (module docstring for the full story):
       retry_policy      — retry.RetryPolicy; a batch whose dispatch or
@@ -480,6 +558,8 @@ def verify_stream(
     pipeline = pipeline and is_async  # sync backends: settle immediately
     if pipeline_depth < 1:
         raise ValueError("pipeline_depth must be >= 1")
+    if prefetch_depth < 0:
+        raise ValueError("prefetch_depth must be >= 0")
     if isinstance(fallback_backend, str):
         fallback_backend = get_backend(fallback_backend)
     fallback_dispatch = (
@@ -560,18 +640,32 @@ def verify_stream(
         state.next_batch = idx + 1
         state.save()
 
+    def _launched():
+        for i in range(state.next_batch, n_batches):
+            sigs, messages_list = source(i)
+            finalize, attempts = launch(i, sigs, messages_list)
+            yield (i, finalize, len(sigs), sigs, messages_list, attempts)
+
+    launched = (
+        _prefetch_launches(_launched, prefetch_depth)
+        if pipeline and prefetch_depth > 0
+        else _launched()
+    )
     pending = []  # [(index, finalize, batch_size, sigs, msgs, attempts)]
-    for i in range(state.next_batch, n_batches):
-        sigs, messages_list = source(i)
-        finalize, attempts = launch(i, sigs, messages_list)
-        if not pipeline:
-            settle(i, finalize, len(sigs), sigs, messages_list, attempts)
-            continue
-        pending.append(
-            (i, finalize, len(sigs), sigs, messages_list, attempts)
-        )
-        if len(pending) >= pipeline_depth:
-            settle(*pending.pop(0))
+    try:
+        for item in launched:
+            if not pipeline:
+                settle(*item)
+                continue
+            pending.append(item)
+            if len(pending) >= pipeline_depth:
+                settle(*pending.pop(0))
+    finally:
+        # a settle error must tear the prefetch worker down NOW, not at
+        # GC (the propagating traceback pins this frame — and with it the
+        # generator — alive), so the worker never lingers blocked on a
+        # full queue
+        launched.close()
     for p in pending:
         settle(*p)
     return state

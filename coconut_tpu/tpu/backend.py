@@ -122,23 +122,50 @@ import os as _os
 
 _GLV_ENABLED = _os.environ.get("COCONUT_GLV", "1") == "1"
 
+# Raw point wire (see _pts_f32 / tw.encode_raw_batch): ship 48 raw
+# canonical bytes per Fp and enter the Montgomery domain on device. Like
+# the comb window, decided LAZILY and per platform: on the real chip the
+# host-side bigint Montgomery encode is the wall (PROFILE_r05), on the CPU
+# test mesh it would only force a recompile of every cached fused program
+# (new operand dtypes) for zero correctness value — the conversion itself
+# is differentially tested at the fp level. COCONUT_RAW_WIRE=0/1 overrides.
+_RAW_WIRE = None
+
+
+def _raw_wire_enabled():
+    global _RAW_WIRE
+    if _RAW_WIRE is None:
+        v = _os.environ.get("COCONUT_RAW_WIRE")
+        if v is not None:
+            _RAW_WIRE = v == "1"
+        else:
+            try:
+                _RAW_WIRE = jax.default_backend() == "tpu"
+            except Exception:  # pragma: no cover - backend init failure
+                _RAW_WIRE = False
+    return _RAW_WIRE
+
 
 def _build_tables(spec_ops, bases, entries=16):
     """Host-side: per-base projective multiples 0..entries-1 as spec
     coordinate tuples (identity = (0, 1, 0), the complete-formula encoding).
     Incremental chain adds (row[d] = row[d-1] + b): one spec add per entry
-    instead of a double-and-add ladder per entry."""
+    instead of a double-and-add ladder per entry. A `None` base (the
+    sharded pad lanes from encode_verify_batch's pad_bases_to) encodes as
+    an all-identity row explicitly — the complete formulas absorb identity
+    entries, and the matching scalars are zero."""
     tables = []
+    ident = (spec_ops.zero, spec_ops.one, spec_ops.zero)
     for b in bases:
+        if b is None:
+            tables.append([ident] * entries)
+            continue
         row = [None]
         for _ in range(1, entries):
             row.append(spec_ops.add(row[-1], b) if row[-1] else b)
         enc = []
         for p in row:
-            if p is None:
-                enc.append((spec_ops.zero, spec_ops.one, spec_ops.zero))
-            else:
-                enc.append((p[0], p[1], spec_ops.one))
+            enc.append(ident if p is None else (p[0], p[1], spec_ops.one))
         tables.append(enc)
     # encode: [k][entries] of (X, Y, Z) -> pytree with leading [k, entries]
     flat = [e for row in tables for e in row]
@@ -213,6 +240,53 @@ def _comb_tables(spec_ops, is_fp2, bases):
         _COMB_CACHE.pop(key)
         _COMB_CACHE[key] = wt
     return wt
+
+
+# Static-operand cache: the per-(verkey, params) invariant half of a batch
+# encode — comb tables over [X_tilde] + Y_tilde, the grouped other-group
+# point uploads, the g_tilde pairing constant. encode_verify_batch used to
+# rebuild these every call even though they never change across a stream;
+# with the cache the steady-state host encode reduces to signature points
+# and scalar digits. Keyed by a verkey/params fingerprint (reusing the
+# stream layer's run_fingerprint) + the comb window (tests monkeypatch the
+# schedule mid-process) + a per-path tag, LRU'd with move-to-end recency
+# exactly like _COMB_CACHE. Hit/miss counters: metrics
+# encode_cache_hits / encode_cache_misses.
+_STATIC_CACHE = {}
+_STATIC_CACHE_MAX = 32
+
+
+def _static_fingerprint(vk, params):
+    """Digest identifying a (verkey, params) pair: the stream-layer run
+    fingerprint (canonical verkey bytes under the params ctx) extended
+    with the params generators — two params contexts sharing a verkey
+    must never share cached operands (g_tilde differs)."""
+    import hashlib
+
+    from ..stream import run_fingerprint
+
+    h = hashlib.sha256()
+    h.update(run_fingerprint("encode", vk, params).encode())
+    h.update(repr((params.ctx.name, params.g, params.g_tilde)).encode())
+    return h.hexdigest()[:16]
+
+
+def _static_operands(kind, vk, params, extra, build):
+    from .. import metrics
+
+    key = (kind, _static_fingerprint(vk, params), _comb_schedule()[0], extra)
+    val = _STATIC_CACHE.get(key)
+    if val is not None:
+        _STATIC_CACHE.pop(key)
+        _STATIC_CACHE[key] = val  # move-to-end: evictions stay LRU
+        metrics.count("encode_cache_hits")
+        return val
+    metrics.count("encode_cache_misses")
+    val = build()
+    while len(_STATIC_CACHE) >= _STATIC_CACHE_MAX:
+        _STATIC_CACHE.pop(next(iter(_STATIC_CACHE)))
+    _STATIC_CACHE[key] = val
+    return val
 
 
 def _signed_digits(scalars_batch, nwin=_SIGNED_NWIN, window=5):
@@ -337,16 +411,31 @@ def _msm_shared_many_kernel(field_is_fp2, jobs):
 
 
 def _pts_f32(tree):
-    """Uploaded point operands travel as int16 limb arrays (halved
-    host->device bytes over the 2-8 MB/s tunnel; balanced encodings are
-    exact integers |v| <= 132, so the int16 round trip is lossless);
-    the field ops run in f32 — cast at kernel entry, where XLA fuses it
-    into the first consumer. f32 inputs pass through unchanged, so
-    device-resident operands and the CPU test path are unaffected."""
-    return jax.tree_util.tree_map(
-        lambda t: t.astype(jnp.float32) if t.dtype != jnp.float32 else t,
-        tree,
-    )
+    """Uploaded point operands enter the field arithmetic here, dispatched
+    on dtype per leaf:
+
+      - uint8 [..., 48]: RAW canonical base-256 digits from the raw wire
+        (tw.encode_raw_batch — 48 B/Fp, no host Montgomery bigints).
+        fp.to_mont pads to 52 limbs and multiplies by R^2 through the
+        existing exact Montgomery kernel, entering the domain on device
+        with bit-identical downstream results (raw digits are valid LAZY
+        mul inputs: |v| <= 255, value < p, limbs 48..51 zero).
+      - int16 [..., 52]: balanced Montgomery limbs (the legacy halved
+        wire; exact integers |v| <= 132) — cast to f32, where XLA fuses
+        the cast into the first consumer.
+      - f32: device-resident operands and the CPU test path, unchanged.
+
+    NOTE the uint8 MONTGOMERY canon48 digits of the device-to-device
+    offset path never come through here — they go through _unpack_pt
+    (no domain conversion), see _msm_distinct_plus_offset_kernel."""
+    from . import fp as _fp_mod
+
+    def conv(t):
+        if t.dtype == jnp.uint8:
+            return _fp_mod.to_mont(t)
+        return t.astype(jnp.float32) if t.dtype != jnp.float32 else t
+
+    return jax.tree_util.tree_map(conv, tree)
 
 
 def verify_tail(sig_is_g1, acc, s1, s2n, gtx, gty, inf1, inf2):
@@ -745,18 +834,25 @@ class JaxBackend(CurveBackend):
 
     # -- encoding helpers ----------------------------------------------------
     #
-    # Point batches upload as int16 limb arrays: balanced Montgomery
-    # encodings are exact integers |v| <= 132, the tunnel moves bytes at
-    # 2-8 MB/s, and every consuming kernel casts back to f32 at entry
-    # (_pts_f32) — so the int16 wire halves the dominant operand transfer
-    # losslessly. The cast to int16 happens in NUMPY, before jnp.asarray
-    # commits the buffer to the device.
+    # Point batches upload on one of two wires, chosen per platform by
+    # _raw_wire_enabled():
+    #
+    #   raw (TPU default): 48 raw canonical uint8 digits per Fp — no host
+    #   bigint Montgomery multiply, no balance-carry loop, and the upload
+    #   halves AGAIN vs int16 (48 B vs 104 B). _pts_f32 enters the
+    #   Montgomery domain at kernel entry via fp.to_mont.
+    #
+    #   int16 (CPU default): balanced Montgomery limbs, exact integers
+    #   |v| <= 132, cast back to f32 at kernel entry. The cast to int16
+    #   happens in NUMPY, before jnp.asarray commits the buffer.
 
     @staticmethod
     def _encode_g1_points(points):
         xs = [(0 if p is None else p[0]) for p in points]
         ys = [(0 if p is None else p[1]) for p in points]
         inf = jnp.asarray(np.array([p is None for p in points]))
+        if _raw_wire_enabled():
+            return (tw.encode_raw_batch(xs), tw.encode_raw_batch(ys)), inf
         return (
             tw.encode_batch(xs, dtype=np.int16),
             tw.encode_batch(ys, dtype=np.int16),
@@ -768,6 +864,8 @@ class JaxBackend(CurveBackend):
         xs = [(zero2 if p is None else p[0]) for p in points]
         ys = [(zero2 if p is None else p[1]) for p in points]
         inf = jnp.asarray(np.array([p is None for p in points]))
+        if _raw_wire_enabled():
+            return (tw.encode_raw_batch(xs), tw.encode_raw_batch(ys)), inf
         return (
             tw.encode_batch(xs, dtype=np.int16),
             tw.encode_batch(ys, dtype=np.int16),
@@ -972,40 +1070,62 @@ class JaxBackend(CurveBackend):
         scalars) up to this length — the sharded path needs the base count
         divisible by the MSM mesh axis."""
         ctx = params.ctx
-        bases = [vk.X_tilde] + list(vk.Y_tilde)
-        scalars = [[1] + [m % R for m in msgs] for msgs in messages_list]
-        if pad_bases_to is not None and len(bases) < pad_bases_to:
-            npad = pad_bases_to - len(bases)
-            bases = bases + [None] * npad
-            scalars = [row + [0] * npad for row in scalars]
-        wtables = _comb_tables(ctx.other, ctx.name == "G1", bases)
+        k = 1 + len(vk.Y_tilde)
+        npad = max(0, (pad_bases_to or 0) - k)
+
+        def build():
+            bases = [vk.X_tilde] + list(vk.Y_tilde) + [None] * npad
+            wtables = _comb_tables(ctx.other, ctx.name == "G1", bases)
+            return (wtables,) + self._encode_gt(ctx, params)
+
+        wtables, gtx, gty = _static_operands(
+            "verify", vk, params, pad_bases_to, build
+        )
+        scalars = [
+            [1] + [m % R for m in msgs] + [0] * npad
+            for msgs in messages_list
+        ]
         mag, sgn = _comb_digits(scalars)
 
-        sig_pts_1 = [s.sigma_1 for s in sigs]
-        sig_pts_2n = [
-            None if s.sigma_2 is None else ctx.sig.neg(s.sigma_2) for s in sigs
-        ]
-        s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
-            ctx, sig_pts_1, sig_pts_2n, params
+        s1, inf1 = self._encode_sig_points(ctx, [s.sigma_1 for s in sigs])
+        s2n, inf2 = self._encode_sig_points(
+            ctx,
+            [
+                None if s.sigma_2 is None else ctx.sig.neg(s.sigma_2)
+                for s in sigs
+            ],
         )
         return (wtables, mag, sgn, s1, s2n, gtx, gty, inf1, inf2)
+
+    def _encode_sig_points(self, ctx, pts):
+        """Signature-group point batch for whichever group assignment
+        `ctx` names — the per-batch (non-cacheable) half of the encode."""
+        if ctx.name == "G1":
+            return self._encode_g1_points(pts)
+        return self._encode_g2_points(pts)
+
+    def _encode_gt(self, ctx, params):
+        """The g_tilde pairing constant (other-group generator) — invariant
+        per params, so it rides the static-operand cache with the tables."""
+        if ctx.name == "G1":
+            return (
+                tw.fp2_encode_const(params.g_tilde[0]),
+                tw.fp2_encode_const(params.g_tilde[1]),
+            )
+        from .limbs import fp_encode
+
+        return (
+            jnp.asarray(fp_encode(params.g_tilde[0])),
+            jnp.asarray(fp_encode(params.g_tilde[1])),
+        )
 
     def _encode_sigs_and_gt(self, ctx, sig_pts_1, sig_pts_2n, params):
         """Signature-group point batches + the g_tilde constant, encoded for
         whichever group assignment `ctx` names. Shared by the per-credential,
         show-verify, and grouped paths."""
-        if ctx.name == "G1":
-            s1, inf1 = self._encode_g1_points(sig_pts_1)
-            s2n, inf2 = self._encode_g1_points(sig_pts_2n)
-            gtx = tw.fp2_encode_const(params.g_tilde[0])
-            gty = tw.fp2_encode_const(params.g_tilde[1])
-        else:
-            s1, inf1 = self._encode_g2_points(sig_pts_1)
-            s2n, inf2 = self._encode_g2_points(sig_pts_2n)
-            from .limbs import fp_encode
-
-            gtx = jnp.asarray(fp_encode(params.g_tilde[0]))
-            gty = jnp.asarray(fp_encode(params.g_tilde[1]))
+        s1, inf1 = self._encode_sig_points(ctx, sig_pts_1)
+        s2n, inf2 = self._encode_sig_points(ctx, sig_pts_2n)
+        gtx, gty = self._encode_gt(ctx, params)
         return s1, s2n, inf1, inf2, gtx, gty
 
     def batch_verify_async(self, sigs, messages_list, vk, params):
@@ -1148,9 +1268,21 @@ class JaxBackend(CurveBackend):
         oth = ctx.other
         is_g1_ctx = ctx.name == "G1"
 
+        # static operands (Schnorr + pairing comb tables, g_tilde): one
+        # cache entry per (vk, params, revealed-index set)
+        def build():
+            vc_bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
+            acc_bases = [vk.X_tilde] + [vk.Y_tilde[i] for i in revealed]
+            return (
+                _comb_tables(oth, is_g1_ctx, vc_bases),
+                _comb_tables(oth, is_g1_ctx, acc_bases),
+            ) + self._encode_gt(ctx, params)
+
+        vc_wtables, acc_wtables, gtx, gty = _static_operands(
+            "show", vk, params, tuple(revealed), build
+        )
+
         # Schnorr operands
-        vc_bases = [params.g_tilde] + [vk.Y_tilde[i] for i in hidden]
-        vc_wtables = _comb_tables(oth, is_g1_ctx, vc_bases)
         resp_mag, resp_sgn = _comb_digits(
             [[r % R for r in p.proof_vc.responses] for p in proofs]
         )
@@ -1162,22 +1294,21 @@ class JaxBackend(CurveBackend):
         (commx, commy), comminf = enc_other([p.proof_vc.t for p in proofs])
 
         # pairing operands
-        acc_bases = [vk.X_tilde] + [vk.Y_tilde[i] for i in revealed]
-        acc_wtables = _comb_tables(oth, is_g1_ctx, acc_bases)
         acc_mag, acc_sgn = _comb_digits(
             [
                 [1] + [rm[i] % R for i in revealed]
                 for rm in revealed_msgs_list
             ]
         )
-        s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
+        s1, inf1 = self._encode_sig_points(
+            ctx, [p.sigma_prime_1 for p in proofs]
+        )
+        s2n, inf2 = self._encode_sig_points(
             ctx,
-            [p.sigma_prime_1 for p in proofs],
             [
                 None if p.sigma_prime_2 is None else ctx.sig.neg(p.sigma_prime_2)
                 for p in proofs
             ],
-            params,
         )
         return (
             vc_wtables,
@@ -1268,21 +1399,24 @@ class JaxBackend(CurveBackend):
         rmag = cmag[:1, :, nwin - _G_RNWIN :]
         rsgn = csgn[:1, :, nwin - _G_RNWIN :]
 
-        s1, s2n, inf1, inf2, gtx, gty = self._encode_sigs_and_gt(
-            ctx,
-            [s.sigma_1 for s in sigs],
-            [ctx.sig.neg(s.sigma_2) for s in sigs],
-            params,
+        s1, inf1 = self._encode_sig_points(ctx, [s.sigma_1 for s in sigs])
+        s2n, inf2 = self._encode_sig_points(
+            ctx, [ctx.sig.neg(s.sigma_2) for s in sigs]
         )
-        others = [vk.X_tilde] + list(vk.Y_tilde)
-        if ctx.name == "G1":
-            ox = tw.encode_batch([p[0] for p in others])
-            oy = tw.encode_batch([p[1] for p in others])
-        else:
-            from .limbs import fp_encode_batch
 
-            ox = jnp.asarray(fp_encode_batch([p[0] for p in others]))
-            oy = jnp.asarray(fp_encode_batch([p[1] for p in others]))
+        def build():
+            others = [vk.X_tilde] + list(vk.Y_tilde)
+            if ctx.name == "G1":
+                ox = tw.encode_batch([p[0] for p in others])
+                oy = tw.encode_batch([p[1] for p in others])
+            else:
+                from .limbs import fp_encode_batch
+
+                ox = jnp.asarray(fp_encode_batch([p[0] for p in others]))
+                oy = jnp.asarray(fp_encode_batch([p[1] for p in others]))
+            return (ox, oy) + self._encode_gt(ctx, params)
+
+        ox, oy, gtx, gty = _static_operands("grouped", vk, params, None, build)
         return (s1, s2n, inf1, inf2, cmag, csgn, rmag, rsgn, ox, oy, gtx, gty)
 
     def batch_verify_sharded(self, sigs, messages_list, vk, params, mesh, **kw):

@@ -250,6 +250,31 @@ def mul(a, b):
     return _norm(hi, 3)
 
 
+_R2_BAL_J = jnp.asarray(
+    balanced_limbs(MONT_R * MONT_R % P), dtype=jnp.float32
+)
+
+# Raw canonical base-256 digits (0..255 per limb) are valid LAZY mul
+# inputs: 255 <= L_LAZY, value < p <= V_LAZY, and p < 2^381 < 256^48 so a
+# 48-byte value leaves limbs 48..51 exactly zero after padding.
+assert 255 <= L_LAZY and P <= V_LAZY and P < 256**48
+
+
+def to_mont(t):
+    """Raw canonical limbs -> Montgomery domain, on device.
+
+    `t` is uint8/float [..., 48 or 52] raw base-256 digits of a canonical
+    Fp value (limbs.fp_encode_raw_batch). One Montgomery multiply by R^2
+    gives x * R^2 * R^-1 = x * R mod p — the same value fp_encode computes
+    with host bigints, via the existing exact mul kernel (XLA or Pallas),
+    so downstream arithmetic is bit-identical to the host-encoded path."""
+    if t.dtype != jnp.float32:
+        t = t.astype(jnp.float32)
+    if t.shape[-1] < NLIMBS:
+        t = _ext(t, NLIMBS - t.shape[-1])
+    return mul(t, _R2_BAL_J)
+
+
 def sq(a):
     return mul(a, a)
 

@@ -140,6 +140,24 @@ def fp_encode_batch(xs):
     return balanced_limbs_batch([int(x) % P * MONT_R % P for x in xs])
 
 
+# Raw (non-Montgomery) wire format: 48 canonical little-endian bytes per Fp.
+RAW_BYTES = 48
+
+
+def fp_encode_raw_batch(xs):
+    """List of canonical Fp ints -> np.uint8[n, RAW_BYTES] raw base-256
+    digits, NOT in the Montgomery domain and NOT balanced.
+
+    This is the cheap half of the host encode: one to_bytes + frombuffer,
+    no bigint Montgomery multiply and no balance-carry loop (those moved
+    on-device — see fp.to_mont, which folds the multiply-by-R^2 domain
+    entry into the existing exact Montgomery-multiply kernel). 48 bytes
+    per element also halves the upload vs the 52 x int16 balanced wire.
+    """
+    buf = b"".join((int(x) % P).to_bytes(RAW_BYTES, "little") for x in xs)
+    return np.frombuffer(buf, dtype=np.uint8).reshape(len(xs), RAW_BYTES)
+
+
 # COCONUT_DEBUG_PACK support: backend._pack_pt's on-device bound check
 # cannot raise from inside jax.debug.callback (the runtime may swallow or
 # defer callback exceptions under jit), so the callback RECORDS violations

@@ -47,6 +47,22 @@ def encode_batch(elems, dtype=None):
     return jnp.asarray(arr)
 
 
+def encode_raw_batch(elems):
+    """Raw-wire variant of encode_batch: pytree of np.uint8[n, 48] raw
+    canonical base-256 digits, NOT in the Montgomery domain. The consuming
+    kernels convert at entry via fp.to_mont (one on-device Montgomery
+    multiply by R^2 — see backend._pts_f32), which keeps the host encode
+    down to byte framing and the upload at 48 bytes per Fp."""
+    first = elems[0]
+    if isinstance(first, tuple):
+        return tuple(
+            encode_raw_batch([e[i] for e in elems]) for i in range(len(first))
+        )
+    from .limbs import fp_encode_raw_batch
+
+    return jnp.asarray(fp_encode_raw_batch(elems))
+
+
 def decode_batch(tree):
     """Inverse of encode_batch: pytree of limb arrays -> list of spec
     elements (canonical ints / nested tuples)."""

@@ -49,3 +49,9 @@ def pytest_configure(config):
         "faults: fault-supervision suite (retry/fallback/bisection/"
         "checkpoint hardening), also run explicitly by ci.sh's fault lane",
     )
+    config.addinivalue_line(
+        "markers",
+        "pipeline: encode-pipeline suite (verify_stream prefetch worker, "
+        "static-operand cache, raw-wire Montgomery parity), also run "
+        "explicitly by ci.sh's pipeline lane",
+    )
