@@ -19,6 +19,7 @@ Also measured (BASELINE.md configs):
   config 3: batched PoKOfSignature verify (2 hidden / 4 revealed)  [default]
   config 4: threshold issuance, batched blind-sign MSMs            [default]
   config 5: short streamed run through verify_stream               [BENCH_STREAM=1]
+  serve lane: loadgen against the online CredentialService         [--serve]
 
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
@@ -27,6 +28,16 @@ BENCH_STREAM (default 1 — config 5 is driver-captured), BENCH_STREAM_BATCHES
 (default 8), BENCH_ISSUE_N (default 1024), BENCH_COMBINED (default 0),
 BENCH_MULTIVK (default 0 — 8-verkey rotation datapoint), BENCH_PROFILE
 (default 0 — one traced rep of the headline to BENCH_PROFILE_DIR).
+
+Serve lane (`python bench.py --serve`): closed-loop loadgen at saturation
+against coconut_tpu/serve (dynamic batching, admission control), embedding
+p50/p95/p99 request latency, goodput, mean batch occupancy, and rejection
+counts in the same JSON line under "serve". Knobs: BENCH_SERVE_SECONDS
+(default 2), BENCH_SERVE_MAX_BATCH (default 4), BENCH_SERVE_CONCURRENCY
+(default 2*max_batch), BENCH_SERVE_MODE (per_credential|grouped),
+BENCH_SERVE_FORGED (default 1 — forged credentials in the pool),
+BENCH_OFFLINE=0 skips the offline lanes so `--serve` can run standalone
+(the CPU smoke in ci.sh does exactly that).
 """
 
 import json
@@ -61,6 +72,80 @@ def bench_python(batch, ge, params, vk, sigs, msgs_list, extras):
     return batch / dt
 
 
+def bench_serve(ge, params, vk, sigs, msgs_list, extras, backend_name):
+    """Online-serving lane: closed-loop loadgen at saturation against the
+    dynamic-batching CredentialService; embeds the SLO report (p50/p95/p99
+    latency, goodput, mean batch occupancy, rejection counts) under
+    extras["serve"]. Returns the goodput (requests/sec)."""
+    from coconut_tpu.serve import CredentialService, run_loadgen
+    from coconut_tpu.signature import Signature
+
+    seconds = float(os.environ.get("BENCH_SERVE_SECONDS", "2"))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", "4"))
+    # 2x max_batch closed-loop clients saturate the coalescer: there is
+    # always a full batch's worth of backlog, so occupancy reads the
+    # batching ceiling rather than arrival luck
+    concurrency = int(
+        os.environ.get("BENCH_SERVE_CONCURRENCY", str(2 * max_batch))
+    )
+    mode = os.environ.get("BENCH_SERVE_MODE", "per_credential")
+    max_wait_ms = float(os.environ.get("BENCH_SERVE_MAX_WAIT_MS", "20"))
+
+    pool = [(s, m, True) for s, m in zip(sigs, msgs_list)]
+    if os.environ.get("BENCH_SERVE_FORGED", "1") == "1":
+        # forged credentials in the mix exercise the demux under load (and,
+        # in grouped mode, the bisection ladder); the loadgen checks each
+        # verdict against its expectation, so a demux bug surfaces as
+        # verdict_mismatches, not as silent throughput
+        for s, m in list(zip(sigs, msgs_list))[: max(1, len(sigs) // 8)]:
+            forged = Signature(s.sigma_1, params.ctx.sig.mul(s.sigma_2, 2))
+            pool.append((forged, m, False))
+
+    svc = CredentialService(
+        backend_name,
+        vk,
+        params,
+        mode=mode,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+    )
+    with svc:
+        # warm the backend at the serving shape OUTSIDE the timed window
+        # (on the jax backend the first batch pays compile time; the
+        # loadgen's occupancy/latency deltas start after this settles)
+        warm = [
+            svc.submit(*pool[i % len(pool)][:2])
+            for i in range(max_batch)
+        ]
+        for f in warm:
+            f.result(timeout=600.0)
+        report = run_loadgen(
+            svc,
+            pool,
+            duration_s=seconds,
+            arrival="closed",
+            concurrency=concurrency,
+        )
+    assert report["dropped_futures"] == 0, (
+        "serve lane dropped futures: %r" % (report,)
+    )
+    assert report["verdict_mismatches"] == 0, (
+        "serve lane verdict mismatch: %r" % (report,)
+    )
+    occ = report["mean_batch_occupancy"]
+    assert occ is not None and occ > 0.5, (
+        "serve lane under-coalesced at saturation "
+        "(mean_batch_occupancy=%r): %r" % (occ, report)
+    )
+    extras["serve"] = {
+        "mode": mode,
+        "max_batch": max_batch,
+        "max_wait_ms": max_wait_ms,
+        **report,
+    }
+    return report["goodput_per_s"]
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "1024"))
     # best-of-5: the tunneled chip shows 30-60% run-to-run variance under
@@ -68,6 +153,10 @@ def main():
     # program); more reps make the best-of timing robust to that noise
     reps = int(os.environ.get("BENCH_REPS", "5"))
     backend_name = os.environ.get("BENCH_BACKEND", "jax")
+    serve_flag = "--serve" in sys.argv[1:]
+    # BENCH_OFFLINE=0 (only meaningful with --serve) skips the offline
+    # lanes so the CI serve smoke doesn't pay for them
+    offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not serve_flag
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import __graft_entry__ as ge
@@ -85,12 +174,26 @@ def main():
 
     from coconut_tpu import metrics
 
-    if backend_name == "python":
-        value = bench_python(batch, ge, params, vk, sigs, msgs_list, extras)
+    if offline:
+        if backend_name == "python":
+            value = bench_python(
+                batch, ge, params, vk, sigs, msgs_list, extras
+            )
+        else:
+            value = bench_jax(
+                batch, reps, ge, params, sk, vk, sigs, msgs_list, extras
+            )
+        metric, unit = "aggregated_credential_verifies_per_sec", "verifies/sec"
     else:
-        value = bench_jax(
-            batch, reps, ge, params, sk, vk, sigs, msgs_list, extras
+        value = None
+
+    if serve_flag:
+        goodput = bench_serve(
+            ge, params, vk, sigs, msgs_list, extras, backend_name
         )
+        if value is None:
+            value = goodput
+            metric, unit = "serve_goodput_per_sec", "requests/sec"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a
@@ -100,9 +203,9 @@ def main():
     print(
         json.dumps(
             {
-                "metric": "aggregated_credential_verifies_per_sec",
+                "metric": metric,
                 "value": round(value, 2),
-                "unit": "verifies/sec",
+                "unit": unit,
                 "vs_baseline": round(value / NORTH_STAR, 4),
                 **extras,
             }

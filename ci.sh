@@ -50,6 +50,32 @@ grep -q '"reason"' "$DLQ"
 grep -q '"attempts"' "$DLQ"
 echo "dead-letter schema: ok"
 
+echo "== serve lane (dynamic batching / admission control / loadgen) =="
+python -m pytest tests/test_serve.py -m serve -q
+# 2-second loadgen smoke against the REAL service on the CPU (python)
+# backend: closed loop at saturation, then assert the SLO report is sane —
+# every accepted future resolved, batches actually coalesced, and the
+# latency percentiles present. bench_serve itself asserts the invariants
+# loudly; the JSON probe re-checks them from the artifact a human reads.
+SERVE_JSON=$(mktemp -d)/serve.json
+BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 \
+  BENCH_SERVE_SECONDS=2 BENCH_SERVE_MAX_BATCH=4 JAX_PLATFORMS=cpu \
+  python bench.py --serve > "$SERVE_JSON"
+SERVE_JSON_PATH="$SERVE_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["SERVE_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["serve"]
+assert report["dropped_futures"] == 0, report
+assert report["verdict_mismatches"] == 0, report
+assert report["mean_batch_occupancy"] > 0.5, report
+assert report["latency_s"]["p99"] is not None, report
+assert report["completed"] > 0 and report["errors"] == 0, report
+print("serve smoke: ok (goodput %.1f/s, occupancy %.2f, p99 %.0f ms)" % (
+    report["goodput_per_s"], report["mean_batch_occupancy"],
+    report["latency_s"]["p99"] * 1000.0))
+EOF
+
 echo "== encode-pipeline lane (prefetch worker / static cache / raw wire) =="
 # lean by construction: only host-side / small-jit tests carry the
 # `pipeline` marker (the kernel-materializing encode tests ride the

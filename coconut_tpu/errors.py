@@ -53,6 +53,29 @@ class TransientBackendError(CoconutError):
     permanent and propagates immediately."""
 
 
+class ServiceOverloadedError(CoconutError):
+    """The serving layer's bounded request queue is at capacity: admission
+    control rejects the request LOUDLY instead of growing the queue without
+    bound (serve/queue.py). Callers should back off and resubmit; the
+    "serve_rejected" counter tracks how often this fires. Carries `depth`
+    (current) and `max_depth` (the configured admission bound)."""
+
+    def __init__(self, depth, max_depth):
+        super().__init__(
+            "serving queue at capacity (%d/%d): request rejected by "
+            "admission control — back off and resubmit" % (depth, max_depth)
+        )
+        self.depth = depth
+        self.max_depth = max_depth
+
+
+class ServiceClosedError(CoconutError):
+    """A request was submitted to (or was still queued in) a credential
+    service that is draining or shut down (serve/service.py). Futures of
+    requests abandoned by a non-draining shutdown resolve with this
+    exception so no caller ever hangs on a dropped future."""
+
+
 class CheckpointCorruptError(CoconutError):
     """A stream checkpoint file failed integrity validation: truncated or
     unparseable bytes, an unknown schema version, or a CRC mismatch.

@@ -10,6 +10,16 @@ path:
     deterministic, so tests/test_faults.py proves the retry / fallback /
     bisection paths without flaky randomness.
 
+    Latency injection (the serving layer's deadline-flush and timeout
+    tests need SLOW dispatches, not just failed ones): `delay_every=N` /
+    `delay_on={i, ...}` schedule a `sleep(delay_s)` immediately before the
+    inner backend runs on the matching 0-based dispatch indices — the same
+    global counter the fault schedules use, so "the 3rd dispatch is slow"
+    is exactly reproducible. `sleep` is injectable (default `time.sleep`):
+    tests pass a recording fake so deadline/timeout behavior is proven
+    without wall-clock flakiness — the schedule stays deterministic either
+    way.
+
   - `DeadLetterLog` is the append-only JSONL file that receives culprit
     credentials isolated by grouped-failure bisection: one object per
     line with the batch index, the credential's index within the batch,
@@ -20,6 +30,7 @@ path:
 
 import json
 import os
+import time
 
 from .errors import TransientBackendError
 
@@ -49,7 +60,14 @@ class FaultyBackend:
                        `error`: for async seams the returned finalizer
                        raises when settled; for sync seams the call raises
                        after the inner compute (the result is lost in
-                       flight).
+                       flight);
+      delay_every=N / delay_on — dispatch indices that `sleep(delay_s)`
+                       BEFORE the inner backend runs (a slow device, not a
+                       dead one): deterministic latency injection for the
+                       serving layer's deadline-flush and timeout tests.
+                       `sleep` is injectable (default time.sleep) so those
+                       tests can record the scheduled delays instead of
+                       actually waiting.
 
     `error` is the exception class raised (default TransientBackendError;
     pass e.g. RuntimeError to model a permanent fault)."""
@@ -61,6 +79,10 @@ class FaultyBackend:
         raise_on=(),
         flip_on=(),
         corrupt_finalizer_on=(),
+        delay_every=None,
+        delay_on=(),
+        delay_s=0.0,
+        sleep=time.sleep,
         error=TransientBackendError,
     ):
         self.inner = inner
@@ -68,6 +90,10 @@ class FaultyBackend:
         self.raise_on = frozenset(raise_on)
         self.flip_on = frozenset(flip_on)
         self.corrupt_finalizer_on = frozenset(corrupt_finalizer_on)
+        self.delay_every = delay_every
+        self.delay_on = frozenset(delay_on)
+        self.delay_s = delay_s
+        self.sleep = sleep
         self.error = error
         self.dispatches = 0
 
@@ -80,6 +106,15 @@ class FaultyBackend:
         if self.raise_every and (idx + 1) % self.raise_every == 0:
             return True
         return idx in self.raise_on
+
+    def _dispatch_delayed(self, idx):
+        if self.delay_every and (idx + 1) % self.delay_every == 0:
+            return True
+        return idx in self.delay_on
+
+    def _maybe_delay(self, idx):
+        if self.delay_s and self._dispatch_delayed(idx):
+            self.sleep(self.delay_s)
 
     def _mangle(self, idx, result):
         if idx in self.flip_on:
@@ -98,6 +133,7 @@ class FaultyBackend:
                     raise self.error(
                         "injected dispatch fault #%d (%s)" % (idx, name)
                     )
+                self._maybe_delay(idx)
                 result = attr(*args, **kwargs)
                 if idx in self.corrupt_finalizer_on:
                     raise self.error(
@@ -114,6 +150,7 @@ class FaultyBackend:
                     raise self.error(
                         "injected dispatch fault #%d (%s)" % (idx, name)
                     )
+                self._maybe_delay(idx)
                 fin = attr(*args, **kwargs)
 
                 def finalize():

@@ -1,11 +1,13 @@
-"""Observability: scoped timers + counters (SURVEY.md §5 "metrics" mandate).
+"""Observability: scoped timers + counters + bounded latency histograms
+(SURVEY.md §5 "metrics" mandate).
 
 The reference has no observability at all (errors are the only signal —
 SURVEY §5); this module provides the minimum the framework's own survey
 demands: per-phase wall-clock timers (host encode / device compile / kernel /
-readback), monotonic counters (verifies, batches, transfer bytes), and a
-`snapshot()` the bench harness embeds in its JSON output so TPU claims are
-auditable.
+readback), monotonic counters (verifies, batches, transfer bytes), bounded
+latency histograms with percentile readout (the serving layer's per-request
+SLO surface), and a `snapshot()` the bench harness embeds in its JSON output
+so TPU claims are auditable.
 
 The stream supervision layer (stream.py / retry.py) reports its fault
 handling through the same counters so `snapshot()` is the single audit
@@ -23,7 +25,25 @@ background worker), and the "prefetch_wait" timer (main-thread seconds
 blocked waiting on the prefetch queue: near zero means the encode worker
 keeps the device fed — pipeline occupancy is 1 - prefetch_wait/wall).
 
-Zero-cost when unused: plain dicts, no background threads, no deps.
+The online serving layer (coconut_tpu/serve/) reports: "serve_admitted" /
+"serve_rejected" (admission control), "serve_batches" /
+"serve_batched_requests" / "serve_pad_lanes" (coalescing — mean batch
+occupancy is batched_requests / (batches * max_batch)), "serve_valid" /
+"serve_invalid" / "serve_failed_requests" / "serve_cancelled" (outcomes),
+and the "serve_latency_s" / "serve_batch_wait_s" histograms.
+
+THREAD SAFETY: the serving layer is the first multi-threaded writer
+(admission happens on client threads while the supervisor thread settles
+batches), so every mutation and `snapshot()` runs under one module lock —
+the bare defaultdict updates this module started with race under free
+threading. Still zero-cost when unused: no background threads, no deps.
+
+Histograms are bounded: `observe(name, seconds)` keeps a fixed-size window
+of the most recent samples (plus exact count/total/max over the full run),
+so a million-request serving run holds kilobytes, not a sample per request.
+Percentiles in `snapshot()` are therefore over the retained window — recent
+behavior, which is what an SLO monitor wants anyway.
+
 Device-side profiling is separate: the hot kernels in tpu/backend.py carry
 `jax.named_scope` annotations (comb_msm, grouped_tables /
 grouped_gather_fold / grouped_horner, miller_two_pairs / grouped_miller,
@@ -32,12 +52,19 @@ affine_norm, final_exp) and `BENCH_PROFILE=1 python bench.py` writes a
 what these timers capture.
 """
 
+import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 from contextlib import contextmanager
 
+_lock = threading.RLock()
 _timers = defaultdict(float)
 _counts = defaultdict(int)
+_hists = {}
+
+# per-histogram retained-sample window (memory bound; count/total/max stay
+# exact over the full run)
+HIST_WINDOW = 4096
 
 
 @contextmanager
@@ -48,36 +75,98 @@ def timer(name):
     try:
         yield
     finally:
-        _timers[name] += time.perf_counter() - t0
+        dt = time.perf_counter() - t0
+        with _lock:
+            _timers[name] += dt
 
 
 def count(name, n=1):
     """Add n to the counter `name` (e.g. "verifies", "transfer_bytes")."""
-    _counts[name] += n
+    with _lock:
+        _counts[name] += n
 
 
 def get_count(name):
     """Current value of counter `name` (0 if never counted)."""
-    return _counts.get(name, 0)
+    with _lock:
+        return _counts.get(name, 0)
 
 
-def snapshot():
-    """{"timers_s": {...}, "counters": {...}} — current totals."""
+def observe(name, seconds):
+    """Record one sample in the bounded histogram `name` (e.g.
+    "serve_latency_s"). Keeps the most recent HIST_WINDOW samples for
+    percentile readout plus exact count/total/max over the full run."""
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            h = _hists[name] = {
+                "count": 0,
+                "total": 0.0,
+                "max": 0.0,
+                "window": deque(maxlen=HIST_WINDOW),
+            }
+        h["count"] += 1
+        h["total"] += seconds
+        if seconds > h["max"]:
+            h["max"] = seconds
+        h["window"].append(seconds)
+
+
+def percentile(samples, q):
+    """q-th percentile (q in [0, 100]) of `samples` by the nearest-rank
+    method; None on an empty list. Small-n honest: p99 of 10 samples is
+    the max, not an interpolated fiction."""
+    if not samples:
+        return None
+    import math
+
+    s = sorted(samples)
+    rank = max(0, min(len(s) - 1, math.ceil(q / 100.0 * len(s)) - 1))
+    return s[rank]
+
+
+def _hist_readout(h):
+    window = list(h["window"])
+    n = h["count"]
     return {
-        "timers_s": {k: round(v, 6) for k, v in sorted(_timers.items())},
-        "counters": dict(sorted(_counts.items())),
+        "count": n,
+        "mean_s": round(h["total"] / n, 6) if n else None,
+        "p50_s": round(percentile(window, 50), 6) if window else None,
+        "p95_s": round(percentile(window, 95), 6) if window else None,
+        "p99_s": round(percentile(window, 99), 6) if window else None,
+        "max_s": round(h["max"], 6),
     }
 
 
+def snapshot():
+    """{"timers_s": {...}, "counters": {...}[, "histograms": {...}]} —
+    current totals; histogram readouts (count / mean / p50 / p95 / p99 /
+    max over the retained window) appear once anything has been
+    observe()d."""
+    with _lock:
+        snap = {
+            "timers_s": {k: round(v, 6) for k, v in sorted(_timers.items())},
+            "counters": dict(sorted(_counts.items())),
+        }
+        if _hists:
+            snap["histograms"] = {
+                k: _hist_readout(h) for k, h in sorted(_hists.items())
+            }
+        return snap
+
+
 def reset():
-    _timers.clear()
-    _counts.clear()
+    with _lock:
+        _timers.clear()
+        _counts.clear()
+        _hists.clear()
 
 
 def rate(counter, timer_name):
     """counter / timer seconds, or None if either is missing/zero."""
-    t = _timers.get(timer_name)
-    c = _counts.get(counter)
+    with _lock:
+        t = _timers.get(timer_name)
+        c = _counts.get(counter)
     if not t or not c:
         return None
     return c / t

@@ -1,0 +1,36 @@
+"""Online serving layer: dynamic-batching credential verification.
+
+Individual show/verify requests arrive asynchronously (the deployment
+shape of PAPER.md's Coconut: users present credentials one at a time);
+the TPU backend only earns its throughput on device-sized batches. This
+package closes that gap — the continuous-batching problem inference
+servers solve, applied to credential verification:
+
+  queue.py    bounded two-lane request queue, per-request futures,
+              loud typed admission control (ServiceOverloadedError)
+  batcher.py  deadline-driven coalescer: flush at max_batch or at the
+              oldest request's max_wait_ms deadline; identity-lane pad
+              partial batches so jit shapes stay cache-hot; demux
+              verdict bits back onto the originating futures
+  service.py  the supervisor thread: dispatch under the PR-2
+              retry/fallback/bisection ladder (one forged credential
+              fails ITS future and is dead-lettered, cohabitants pass),
+              PR-3 async double-buffering, start/drain/shutdown
+  loadgen.py  closed- and open-loop (Poisson) load generation with
+              p50/p95/p99 latency, goodput, occupancy, rejection report
+
+See README.md "Online serving" for architecture and tuning guidance.
+"""
+
+from .loadgen import run_loadgen
+from .queue import DEFAULT_MAX_WAIT_MS, LANES, RequestQueue, ServeFuture
+from .service import CredentialService
+
+__all__ = [
+    "CredentialService",
+    "RequestQueue",
+    "ServeFuture",
+    "run_loadgen",
+    "LANES",
+    "DEFAULT_MAX_WAIT_MS",
+]

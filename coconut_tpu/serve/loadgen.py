@@ -1,0 +1,195 @@
+"""Closed- and open-loop load generation against a CredentialService, with
+the latency/goodput report the serving layer is judged by.
+
+Two arrival disciplines, because they answer different questions:
+
+  - CLOSED loop ("closed"): `concurrency` client threads, each submitting
+    its next request the moment the previous verdict lands. Measures the
+    service AT SATURATION — batch occupancy and goodput ceilings — the
+    way a backfill or a load test drives it.
+  - OPEN loop ("open"): one submitter with Poisson (exponential
+    inter-arrival) timing at `rate_per_s`, never waiting for verdicts.
+    Measures LATENCY UNDER LOAD the way real user traffic does — closed
+    loops hide queueing delay because slow responses throttle the
+    offered load (the classic coordinated-omission trap).
+
+Each request draws (credential, messages, expected_verdict) from `pool`
+(mix valid/forged by building the pool accordingly); verdicts are checked
+against expectations so a demux bug shows up as `verdict_mismatches`, not
+silently as throughput. Every future is awaited: `dropped_futures` counts
+futures that never resolved (must be 0 — the service guarantees it) and
+`errors` counts futures that resolved exceptionally.
+
+The report embeds client-observed p50/p95/p99/mean/max latency, goodput
+(verdicts delivered per second of wall), mean batch occupancy
+(coalesced requests per flushed batch / max_batch, from the metrics
+counters' delta over the run), and the admission rejection rate.
+
+Determinism knobs: `rng` (arrival jitter + pool sampling), `clock`, and
+`sleep` are injectable, so tests can drive the generator without
+wall-clock flakiness; the 2-second CI smoke uses the real ones.
+"""
+
+import random
+import threading
+import time
+
+from .. import metrics
+from ..errors import ServiceClosedError, ServiceOverloadedError
+
+
+def _percentiles(latencies):
+    return {
+        "p50": metrics.percentile(latencies, 50),
+        "p95": metrics.percentile(latencies, 95),
+        "p99": metrics.percentile(latencies, 99),
+        "mean": (sum(latencies) / len(latencies)) if latencies else None,
+        "max": max(latencies) if latencies else None,
+    }
+
+
+class _Tally:
+    """Shared, locked accounting across client threads."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.latencies = []
+        self.submitted = 0
+        self.rejected = 0
+        self.completed = 0
+        self.errors = 0
+        self.dropped = 0
+        self.valid = 0
+        self.invalid = 0
+        self.mismatches = 0
+
+    def settle(self, future, expect_valid, t_submit, clock, timeout):
+        """Await one future and fold its outcome in."""
+        try:
+            verdict = future.result(timeout)
+        except TimeoutError:
+            with self.lock:
+                self.dropped += 1
+            return
+        except Exception:
+            with self.lock:
+                self.errors += 1
+            return
+        dt = clock() - t_submit
+        with self.lock:
+            self.completed += 1
+            self.latencies.append(dt)
+            if verdict:
+                self.valid += 1
+            else:
+                self.invalid += 1
+            if bool(verdict) != bool(expect_valid):
+                self.mismatches += 1
+
+
+def run_loadgen(
+    service,
+    pool,
+    duration_s=2.0,
+    arrival="closed",
+    concurrency=8,
+    rate_per_s=100.0,
+    lane="interactive",
+    rng=None,
+    clock=time.monotonic,
+    sleep=time.sleep,
+    result_timeout=60.0,
+):
+    """Drive `service` for `duration_s` and return the report dict.
+
+    pool: non-empty list of (sig, messages, expect_valid) tuples to sample
+    from. arrival: "closed" (concurrency threads, submit-on-completion) or
+    "open" (Poisson arrivals at rate_per_s, verdicts awaited at the end).
+    The service must already be started; it is NOT drained here — callers
+    own lifecycle (the bench lane drains after reading the report)."""
+    if not pool:
+        raise ValueError("loadgen pool must be non-empty")
+    if arrival not in ("closed", "open"):
+        raise ValueError("unknown arrival discipline %r" % (arrival,))
+    rng = rng if rng is not None else random.Random(0x5E21E)
+    tally = _Tally()
+    occ0_reqs = metrics.get_count("serve_batched_requests")
+    occ0_batches = metrics.get_count("serve_batches")
+    t0 = clock()
+    t_end = t0 + duration_s
+
+    def submit_one():
+        sig, messages, expect_valid = pool[rng.randrange(len(pool))]
+        t_submit = clock()
+        try:
+            fut = service.submit(sig, messages, lane=lane)
+        except ServiceOverloadedError:
+            with tally.lock:
+                tally.submitted += 1
+                tally.rejected += 1
+            return None
+        except ServiceClosedError:
+            return None
+        with tally.lock:
+            tally.submitted += 1
+        return fut, expect_valid, t_submit
+
+    if arrival == "closed":
+
+        def client():
+            while clock() < t_end:
+                sub = submit_one()
+                if sub is None:
+                    continue
+                fut, expect_valid, t_submit = sub
+                tally.settle(fut, expect_valid, t_submit, clock, result_timeout)
+
+        threads = [
+            threading.Thread(target=client, name="loadgen-%d" % i)
+            for i in range(concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:
+        outstanding = []
+        while clock() < t_end:
+            sub = submit_one()
+            if sub is not None:
+                outstanding.append(sub)
+            sleep(rng.expovariate(rate_per_s))
+        for fut, expect_valid, t_submit in outstanding:
+            tally.settle(fut, expect_valid, t_submit, clock, result_timeout)
+
+    elapsed = max(clock() - t0, 1e-9)
+    d_reqs = metrics.get_count("serve_batched_requests") - occ0_reqs
+    d_batches = metrics.get_count("serve_batches") - occ0_batches
+    occupancy = (
+        d_reqs / (d_batches * service.max_batch) if d_batches else None
+    )
+    return {
+        "arrival": arrival,
+        "duration_s": round(elapsed, 3),
+        "concurrency": concurrency if arrival == "closed" else None,
+        "offered_rate_per_s": rate_per_s if arrival == "open" else None,
+        "submitted": tally.submitted,
+        "rejected": tally.rejected,
+        "completed": tally.completed,
+        "errors": tally.errors,
+        "dropped_futures": tally.dropped,
+        "valid": tally.valid,
+        "invalid": tally.invalid,
+        "verdict_mismatches": tally.mismatches,
+        "latency_s": _percentiles(tally.latencies),
+        "goodput_per_s": round(tally.completed / elapsed, 2),
+        "mean_batch_occupancy": (
+            round(occupancy, 4) if occupancy is not None else None
+        ),
+        "batches": d_batches,
+        "rejection_rate": (
+            round(tally.rejected / tally.submitted, 4)
+            if tally.submitted
+            else None
+        ),
+    }

@@ -1,0 +1,194 @@
+"""Thread-safe request queue: per-request futures, priority lanes, and
+bounded-depth admission control.
+
+The online path's front door. Client threads `submit()` individual
+show/verify requests; the batcher (serve/batcher.py) pops them in
+device-sized groups. Three properties the offline stream never needed:
+
+  - PER-REQUEST FUTURES: every request carries a `ServeFuture` the client
+    blocks on; the supervisor resolves it with the request's own verdict
+    (or an exception) after demux. A future always resolves — drain,
+    shutdown, and worker-crash paths all sweep stragglers.
+
+  - PRIORITY LANES: "interactive" requests (a user at a turnstile) pop
+    before "bulk" ones (a ledger backfill) within every coalesced batch,
+    so bulk traffic can saturate the device without starving the latency-
+    sensitive lane. FIFO within a lane, so each lane's head is its oldest
+    request and the earliest deadline is min over the two heads.
+
+  - BOUNDED-DEPTH ADMISSION CONTROL: `submit()` raises
+    `ServiceOverloadedError` (errors.py) the moment the queue holds
+    `max_depth` requests. Rejecting loudly at the front door is the only
+    stable overload behavior — an unbounded queue converts overload into
+    unbounded latency for EVERY request and an eventual OOM, while a
+    typed error lets the client back off, shed load, or route elsewhere.
+    Counters: "serve_admitted" / "serve_rejected".
+
+Time comes from an injectable `clock` (default time.monotonic) so deadline
+logic is testable with a fake clock and zero real sleeps; `kick()` wakes
+the batcher to re-read the clock after a test advances it.
+"""
+
+import threading
+import time
+from collections import deque
+
+from .. import metrics
+from ..errors import ServiceClosedError, ServiceOverloadedError
+
+#: priority lanes, pop order: interactive requests coalesce ahead of bulk
+LANES = ("interactive", "bulk")
+
+#: default per-request coalescing deadline (ms) when the submitter gives none
+DEFAULT_MAX_WAIT_MS = 20.0
+
+
+class ServeFuture:
+    """Single-assignment result slot a client thread blocks on.
+
+    Resolves exactly once, with either a verdict (`set_result`) or an
+    exception (`set_exception`); later resolutions are ignored so the
+    supervisor's crash-sweep can never clobber a real verdict. `result()`
+    returns the verdict or re-raises the stored exception."""
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def done(self):
+        return self._done.is_set()
+
+    def set_result(self, value):
+        if not self._done.is_set():
+            self._result = value
+            self._done.set()
+
+    def set_exception(self, exc):
+        if not self._done.is_set():
+            self._exc = exc
+            self._done.set()
+
+    def exception(self, timeout=None):
+        """The stored exception (None if the future resolved with a
+        verdict); raises TimeoutError if unresolved within `timeout`."""
+        if not self._done.wait(timeout):
+            raise TimeoutError("request future unresolved")
+        return self._exc
+
+    def result(self, timeout=None):
+        exc = self.exception(timeout)
+        if exc is not None:
+            raise exc
+        return self._result
+
+
+class Request:
+    """One queued credential-verify request: the credential, its message
+    vector, the lane, the coalescing deadline, and the client's future."""
+
+    __slots__ = ("sig", "messages", "lane", "max_wait_ms", "t_submit", "future")
+
+    def __init__(self, sig, messages, lane, max_wait_ms, t_submit):
+        if lane not in LANES:
+            raise ValueError("unknown lane %r (want one of %s)" % (lane, LANES))
+        self.sig = sig
+        self.messages = messages
+        self.lane = lane
+        self.max_wait_ms = max_wait_ms
+        self.t_submit = t_submit
+        self.future = ServeFuture()
+
+    @property
+    def deadline(self):
+        """Absolute clock time by which this request wants to be IN a
+        flushed batch (submit time + its max_wait_ms budget)."""
+        return self.t_submit + self.max_wait_ms / 1000.0
+
+
+class RequestQueue:
+    """Bounded two-lane FIFO with a condition variable shared by submitters
+    and the batcher. All waiting/flush policy lives in serve/batcher.py;
+    this class owns admission, ordering, and close semantics."""
+
+    def __init__(self, max_depth=1024, clock=time.monotonic):
+        if max_depth < 1:
+            raise ValueError("max_depth must be >= 1 (got %r)" % (max_depth,))
+        self.max_depth = max_depth
+        self.clock = clock
+        self.cond = threading.Condition()
+        self.closed = False
+        self._lanes = {lane: deque() for lane in LANES}
+
+    # -- submit side --------------------------------------------------------
+
+    def submit(self, sig, messages, lane="interactive", max_wait_ms=None):
+        """Admit one request and return its ServeFuture. Raises
+        ServiceClosedError after close(), ServiceOverloadedError at the
+        admission bound (counted under "serve_rejected")."""
+        if max_wait_ms is None:
+            max_wait_ms = DEFAULT_MAX_WAIT_MS
+        req = Request(sig, messages, lane, max_wait_ms, self.clock())
+        with self.cond:
+            if self.closed:
+                raise ServiceClosedError(
+                    "service is draining/shut down: submission refused"
+                )
+            depth = self._depth_locked()
+            if depth >= self.max_depth:
+                metrics.count("serve_rejected")
+                raise ServiceOverloadedError(depth, self.max_depth)
+            self._lanes[lane].append(req)
+            metrics.count("serve_admitted")
+            self.cond.notify_all()
+        return req.future
+
+    def close(self):
+        """Stop admitting; wake the batcher so it flushes the remainder."""
+        with self.cond:
+            self.closed = True
+            self.cond.notify_all()
+
+    def kick(self):
+        """Wake any batcher wait so it re-reads the clock — used after a
+        test's fake clock advances past a deadline."""
+        with self.cond:
+            self.cond.notify_all()
+
+    # -- batcher side (call with self.cond held) -----------------------------
+
+    def _depth_locked(self):
+        return sum(len(d) for d in self._lanes.values())
+
+    def _earliest_deadline_locked(self):
+        """Earliest deadline over EVERYTHING queued — not just the lane
+        heads: a later arrival with a tighter max_wait_ms budget can owe a
+        flush before the (older) head does. O(depth), and depth is bounded
+        by admission control. None when empty."""
+        earliest = None
+        for d in self._lanes.values():
+            for req in d:
+                if earliest is None or req.deadline < earliest:
+                    earliest = req.deadline
+        return earliest
+
+    def _pop_locked(self, n):
+        """Pop up to n requests, interactive lane first."""
+        out = []
+        for lane in LANES:
+            d = self._lanes[lane]
+            while d and len(out) < n:
+                out.append(d.popleft())
+        return out
+
+    def depth(self):
+        with self.cond:
+            return self._depth_locked()
+
+    def drain_pending(self):
+        """Pop EVERYTHING queued (the non-draining shutdown path: the
+        caller fails these futures with ServiceClosedError)."""
+        with self.cond:
+            out = self._pop_locked(self._depth_locked())
+            self.cond.notify_all()
+            return out

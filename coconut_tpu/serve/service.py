@@ -1,0 +1,332 @@
+"""The online credential service: a supervisor loop wiring the deadline
+batcher into the existing offline machinery.
+
+One background thread owns the device: it pops coalesced batches off the
+request queue (serve/batcher.py), dispatches them through the SAME seams
+the offline stream uses, and demuxes per-credential verdicts back onto the
+originating futures. Everything fault- and perf-related is reused, not
+reinvented:
+
+  - PR-2 supervision: each batch's dispatch+readback cycle runs under
+    `retry.call_with_retry` (bounded backoff, deterministic jitter), then
+    degrades to `fallback_backend`; in grouped mode a rejected batch is
+    bisected with `stream._make_bisector` — grouped probes over halved
+    slices, per-credential at the leaves — so ONE forged credential fails
+    ITS future (and lands in the dead-letter JSONL) while every cohabiting
+    request in the batch resolves valid.
+  - PR-3 pipelining: dispatch goes through the backends' `*_async` seams
+    (probed by `stream._dispatchers`), so while the device runs batch i
+    the supervisor coalesces and host-encodes batch i+1 — the encode rides
+    the static-operand cache, so at steady state it is signature points +
+    scalar digits only. One batch stays in flight (double-buffering);
+    when no new batch is ready the in-flight one settles immediately, so
+    idle-tail latency never waits on future traffic.
+
+Request path: `submit()` -> admission control (bounded queue, typed
+rejection) -> coalesce (full batch or oldest deadline) -> identity-pad to
+the cache-hot shape -> dispatch under retry/fallback -> demux -> future
+resolves. Per-request latency lands in the "serve_latency_s" histogram
+(`metrics.snapshot()["histograms"]`), the SLO readout.
+
+Lifecycle: `start()` launches the supervisor; `drain()` closes intake,
+flushes and settles everything in flight, and joins the thread — every
+accepted future is resolved. `shutdown(drain=False)` instead fails still-
+QUEUED requests with `ServiceClosedError` (in-flight work still settles).
+A supervisor crash sweeps all queued+in-flight futures with the crash
+exception — no caller ever hangs on a dropped future. The context-manager
+form (`with CredentialService(...) as svc:`) is start()/drain().
+"""
+
+import threading
+import time
+
+from .. import metrics
+from ..errors import ServiceClosedError
+from ..retry import RetryPolicy, call_with_retry, note_attempt
+from ..stream import _dispatchers, _fallback_dispatcher, _make_bisector
+from .batcher import Batcher, demux, fail_all, pad_batch
+from .queue import RequestQueue
+
+
+class CredentialService:
+    """Dynamic-batching verify service over any verify-capable backend.
+
+    backend / fallback_backend: instances or registry names ("python",
+    "jax", ...). mode: "per_credential" (bits demux directly) or "grouped"
+    (one device bool per batch; a rejection bisects to per-request
+    verdicts, culprits dead-lettered). max_batch: the coalesced device
+    shape. max_wait_ms: default per-request coalescing deadline.
+    max_depth: admission bound. pad_partial: identity-pad partial batches
+    to max_batch (per_credential mode) so jit shapes stay cache-hot —
+    grouped mode never pads, its encode pads internally to a power of two.
+    clock: injectable time source for deadline tests."""
+
+    def __init__(
+        self,
+        backend,
+        vk,
+        params,
+        mode="per_credential",
+        max_batch=64,
+        max_wait_ms=20.0,
+        max_depth=1024,
+        retry_policy=None,
+        fallback_backend=None,
+        dead_letter_path=None,
+        pad_partial=True,
+        clock=time.monotonic,
+    ):
+        from ..backend import get_backend
+        from ..errors import TransientBackendError
+
+        if backend is None or isinstance(backend, str):
+            backend = get_backend(backend or "python")
+        if isinstance(fallback_backend, str):
+            fallback_backend = get_backend(fallback_backend)
+        if mode not in ("per_credential", "grouped"):
+            raise ValueError("unknown serve mode %r" % (mode,))
+        self.backend = backend
+        self.vk = vk
+        self.params = params
+        self.mode = mode
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.pad_partial = pad_partial and mode == "per_credential"
+        self.clock = clock
+        self._dispatch, _, self._is_async = _dispatchers(backend, mode)
+        self._fallback_dispatch = (
+            _fallback_dispatcher(fallback_backend, mode)
+            if fallback_backend is not None
+            else None
+        )
+        if retry_policy is None:
+            # mirror verify_stream: no ladder means transient errors go
+            # straight to the fallback when one exists, else propagate
+            retry_policy = RetryPolicy(
+                max_attempts=1,
+                base_delay=0.0,
+                retryable=(
+                    (TransientBackendError,)
+                    if self._fallback_dispatch is not None
+                    else ()
+                ),
+            )
+        self._policy = retry_policy
+        self._bisector = (
+            _make_bisector(
+                backend,
+                fallback_backend,
+                vk,
+                params,
+                retry_policy,
+                dead_letter_path,
+            )
+            if mode == "grouped"
+            else None
+        )
+        self._queue = RequestQueue(max_depth=max_depth, clock=clock)
+        self._batcher = Batcher(self._queue, max_batch, clock=clock)
+        self._thread = None
+        self._batch_seq = 0  # dead-letter batch ids + retry jitter keys
+        self._crashed = None
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(self, sig, messages, lane="interactive", max_wait_ms=None):
+        """Admit one verify request; returns its ServeFuture (resolves to
+        the request's own verdict bool). Raises ServiceOverloadedError at
+        the admission bound, ServiceClosedError after drain/shutdown."""
+        if self._crashed is not None:
+            raise ServiceClosedError(
+                "service supervisor crashed: %r" % (self._crashed,)
+            )
+        return self._queue.submit(
+            sig,
+            messages,
+            lane=lane,
+            max_wait_ms=(
+                self.max_wait_ms if max_wait_ms is None else max_wait_ms
+            ),
+        )
+
+    def depth(self):
+        return self._queue.depth()
+
+    def kick(self):
+        """Wake the supervisor to re-read the clock (fake-clock tests)."""
+        self._queue.kick()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="coconut-serve", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def drain(self, timeout=None):
+        """Close intake, settle every accepted request, join the
+        supervisor. Every accepted future is resolved on return (True iff
+        the supervisor exited within `timeout`)."""
+        self._queue.close()
+        if self._thread is None:
+            # never started: nothing will settle the queue — fail loudly
+            fail_all(
+                self._queue.drain_pending(),
+                ServiceClosedError("service drained before start()"),
+                counter="serve_cancelled",
+            )
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def shutdown(self, drain=True, timeout=None):
+        """drain=True: alias for drain(). drain=False: refuse the queued
+        backlog (futures fail with ServiceClosedError) but still settle
+        work already in flight, then join."""
+        if drain:
+            return self.drain(timeout)
+        self._queue.close()
+        fail_all(
+            self._queue.drain_pending(),
+            ServiceClosedError("service shut down before this request ran"),
+            counter="serve_cancelled",
+        )
+        if self._thread is not None:
+            self._thread.join(timeout)
+            return not self._thread.is_alive()
+        return True
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.drain()
+        return False
+
+    # -- supervisor ----------------------------------------------------------
+
+    def _launch(self, requests):
+        """Assemble + dispatch one coalesced batch NOW; return the settle
+        closure state. Mirrors stream.verify_stream's launch(): the first
+        dispatch attempt is consumed eagerly (pipelining), finalize()
+        re-runs the full dispatch+readback cycle under the retry ladder,
+        then the fallback."""
+        seq = self._batch_seq
+        self._batch_seq += 1
+        if self.pad_partial:
+            sigs, messages_list, _ = pad_batch(requests, self.max_batch)
+        else:
+            sigs = [r.sig for r in requests]
+            messages_list = [r.messages for r in requests]
+        metrics.observe(
+            "serve_batch_wait_s",
+            self.clock() - min(r.t_submit for r in requests),
+        )
+        attempts = []
+        box = [None]
+        permanent = None
+        try:
+            box[0] = self._dispatch(sigs, messages_list, self.vk, self.params)
+        except self._policy.retryable as e:
+            note_attempt(attempts, e)
+        except Exception as e:
+            # permanent dispatch failure (bad inputs, code bug in a sync
+            # backend's compute): unlike the offline stream — where it
+            # aborts the run — the service contains it to THIS batch's
+            # futures; finalize re-raises without burning retries
+            permanent = e
+
+        def cycle():
+            fin, box[0] = box[0], None
+            if fin is None:
+                fin = self._dispatch(
+                    sigs, messages_list, self.vk, self.params
+                )
+            return fin()
+
+        fallback = (
+            (
+                lambda: self._fallback_dispatch(
+                    sigs, messages_list, self.vk, self.params
+                )()
+            )
+            if self._fallback_dispatch is not None
+            else None
+        )
+
+        def finalize():
+            if permanent is not None:
+                raise permanent
+            return call_with_retry(
+                cycle,
+                self._policy,
+                key=seq,
+                attempts=attempts,
+                fallback=fallback,
+            )
+
+        return (seq, requests, sigs, messages_list, finalize, attempts)
+
+    def _settle(self, seq, requests, sigs, messages_list, finalize, attempts):
+        """Block on the batch result and resolve every request's future."""
+        try:
+            result = finalize()
+        except Exception as e:
+            # batch-level failure past retry+fallback: each cohabiting
+            # future gets the exception — never a silent hang
+            fail_all(requests, e)
+            return
+        if self.mode == "per_credential":
+            demux(requests, result[: len(requests)], clock=self.clock)
+            return
+        if result:
+            demux(requests, [True] * len(requests), clock=self.clock)
+            return
+        # grouped rejection: recover per-request verdicts by bisection so
+        # one forged credential fails only its own future
+        culprits = (
+            set(self._bisector(sigs, messages_list, seq, attempts))
+            if self._bisector is not None
+            else set(range(len(requests)))
+        )
+        demux(
+            requests,
+            [i not in culprits for i in range(len(requests))],
+            clock=self.clock,
+        )
+
+    def _run(self):
+        pending = None
+        try:
+            while True:
+                batch = self._batcher.next_batch(block=pending is None)
+                if batch:
+                    launched = self._launch(batch)
+                    if pending is not None:
+                        self._settle(*pending)
+                        pending = None
+                    if self._is_async:
+                        # double-buffer: leave this batch in flight and go
+                        # coalesce+encode the next while the device runs
+                        pending = launched
+                    else:
+                        self._settle(*launched)
+                    continue
+                if pending is not None:
+                    # nothing ready to overlap with: settle the in-flight
+                    # batch now instead of holding its latency hostage
+                    self._settle(*pending)
+                    pending = None
+                    continue
+                # blocking pop returned empty: closed and fully drained
+                return
+        except BaseException as e:  # supervisor crash: sweep every future
+            self._crashed = e
+            if pending is not None:
+                fail_all(pending[1], e)
+            self._queue.close()
+            fail_all(self._queue.drain_pending(), e)
+            raise

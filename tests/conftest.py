@@ -55,3 +55,9 @@ def pytest_configure(config):
         "static-operand cache, raw-wire Montgomery parity), also run "
         "explicitly by ci.sh's pipeline lane",
     )
+    config.addinivalue_line(
+        "markers",
+        "serve: online serving layer suite (dynamic batching, deadline "
+        "coalescing, admission control, demux/drain invariants, loadgen), "
+        "also run explicitly by ci.sh's serve lane",
+    )

@@ -1,0 +1,550 @@
+"""Online serving layer suite (ISSUE 4): dynamic batching, deadline
+coalescing, admission control, demux, drain/shutdown, and the loadgen.
+
+Economics mirror tests/test_faults.py: everything runs on stub backends
+(SimpleNamespace credentials carrying their own verdict) with injected
+clocks — deadline logic is proven by ADVANCING a fake clock, never by
+sleeping in an assert. The only real waiting is millisecond-scale
+drain/flush latency inside the service's own machinery."""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from coconut_tpu import metrics
+from coconut_tpu.errors import (
+    ServiceClosedError,
+    ServiceOverloadedError,
+    TransientBackendError,
+)
+from coconut_tpu.faults import DeadLetterLog, FaultyBackend
+from coconut_tpu.retry import RetryPolicy
+from coconut_tpu.serve import CredentialService, RequestQueue, run_loadgen
+from coconut_tpu.serve.batcher import Batcher, pad_batch
+from coconut_tpu.serve.queue import ServeFuture
+
+pytestmark = pytest.mark.serve
+
+
+# --- stub world ------------------------------------------------------------
+
+
+def _cred(ok=True):
+    return SimpleNamespace(sigma_1=1, sigma_2=1, ok=ok)
+
+
+def _lane_bit(s):
+    """Stub verdict for one lane: its own ok flag, identity lanes False —
+    the same identity-lane semantics every real backend has."""
+    return s.sigma_1 is not None and bool(getattr(s, "ok", False))
+
+
+class StubPerCred:
+    """Per-credential stub; records every dispatched batch size so the
+    cache-hot-shape (padding) invariant is assertable."""
+
+    def __init__(self):
+        self.batch_sizes = []
+
+    def batch_verify(self, sigs, msgs, vk, params):
+        self.batch_sizes.append(len(sigs))
+        return [_lane_bit(s) for s in sigs]
+
+
+class StubGrouped:
+    def batch_verify_grouped(self, sigs, msgs, vk, params):
+        return all(_lane_bit(s) for s in sigs)
+
+
+class GatedPerCred(StubPerCred):
+    """Blocks inside verify until released — holds the supervisor busy so
+    admission-control tests can fill the queue deterministically."""
+
+    def __init__(self):
+        super().__init__()
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def batch_verify(self, sigs, msgs, vk, params):
+        self.entered.set()
+        assert self.release.wait(10.0), "gate never released"
+        return super().batch_verify(sigs, msgs, vk, params)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _policy(**kw):
+    kw.setdefault("max_attempts", 3)
+    kw.setdefault("base_delay", 0.0)
+    return RetryPolicy(**kw)
+
+
+def _service(backend, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 2.0)
+    return CredentialService(backend, None, None, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# --- futures ---------------------------------------------------------------
+
+
+def test_future_single_assignment_first_wins():
+    f = ServeFuture()
+    assert not f.done()
+    f.set_result(True)
+    f.set_result(False)  # ignored
+    f.set_exception(RuntimeError("late"))  # ignored
+    assert f.done() and f.result(0) is True and f.exception(0) is None
+
+
+def test_future_exception_and_timeout():
+    f = ServeFuture()
+    with pytest.raises(TimeoutError):
+        f.result(timeout=0.001)
+    f.set_exception(RuntimeError("boom"))
+    assert isinstance(f.exception(0), RuntimeError)
+    with pytest.raises(RuntimeError):
+        f.result(0)
+
+
+# --- queue: admission control + priority lanes -----------------------------
+
+
+def test_admission_control_rejects_loudly_at_capacity():
+    q = RequestQueue(max_depth=2, clock=FakeClock())
+    q.submit(_cred(), [0])
+    q.submit(_cred(), [0])
+    with pytest.raises(ServiceOverloadedError) as ei:
+        q.submit(_cred(), [0])
+    assert ei.value.depth == 2 and ei.value.max_depth == 2
+    assert metrics.get_count("serve_rejected") == 1
+    assert metrics.get_count("serve_admitted") == 2
+    assert q.depth() == 2  # the rejected request never entered
+
+
+def test_submit_after_close_raises_typed():
+    q = RequestQueue(max_depth=4, clock=FakeClock())
+    q.close()
+    with pytest.raises(ServiceClosedError):
+        q.submit(_cred(), [0])
+
+
+def test_interactive_lane_pops_before_bulk():
+    clock = FakeClock()
+    q = RequestQueue(max_depth=8, clock=clock)
+    b = Batcher(q, max_batch=3, clock=clock)
+    c_bulk = [_cred() for _ in range(2)]
+    c_int = [_cred() for _ in range(2)]
+    q.submit(c_bulk[0], [0], lane="bulk")
+    q.submit(c_bulk[1], [1], lane="bulk")
+    q.submit(c_int[0], [2], lane="interactive")
+    q.submit(c_int[1], [3], lane="interactive")
+    batch = b.next_batch(block=False)  # full: 4 queued >= max_batch 3
+    assert [r.sig for r in batch] == [c_int[0], c_int[1], c_bulk[0]]
+    assert [r.messages for r in batch] == [[2], [3], [0]]
+
+
+def test_unknown_lane_rejected():
+    q = RequestQueue(max_depth=4, clock=FakeClock())
+    with pytest.raises(ValueError):
+        q.submit(_cred(), [0], lane="vip")
+
+
+# --- batcher: flush policy (fake clock, zero sleeps) ------------------------
+
+
+def test_full_batch_flushes_immediately_before_any_deadline():
+    clock = FakeClock()
+    q = RequestQueue(max_depth=8, clock=clock)
+    b = Batcher(q, max_batch=2, clock=clock)
+    q.submit(_cred(), [0], max_wait_ms=10_000)
+    q.submit(_cred(), [0], max_wait_ms=10_000)
+    batch = b.next_batch(block=False)
+    assert batch is not None and len(batch) == 2
+
+
+def test_deadline_flush_fires_when_oldest_deadline_expires():
+    clock = FakeClock()
+    q = RequestQueue(max_depth=8, clock=clock)
+    b = Batcher(q, max_batch=4, clock=clock)
+    q.submit(_cred(), [0], max_wait_ms=50)  # oldest: deadline t=0.050
+    clock.advance(0.010)
+    q.submit(_cred(), [0], max_wait_ms=500)
+    assert b.next_batch(block=False) is None  # nothing expired yet
+    clock.advance(0.039)  # t=0.049 < 0.050
+    assert b.next_batch(block=False) is None
+    clock.advance(0.002)  # t=0.051: oldest deadline expired
+    batch = b.next_batch(block=False)
+    assert batch is not None and len(batch) == 2  # partial flush takes all
+    assert metrics.get_count("serve_batches") == 1
+    assert metrics.get_count("serve_batched_requests") == 2
+
+
+def test_blocking_deadline_flush_fires_within_tolerance():
+    # real clock, one ~10 ms coalescing window: the wait must not return
+    # EARLY (deadline honored) and must fire well within tolerance
+    q = RequestQueue(max_depth=8)
+    b = Batcher(q, max_batch=4)
+    q.submit(_cred(), [0], max_wait_ms=10)
+    t0 = time.monotonic()
+    batch = b.next_batch(block=True)
+    dt = time.monotonic() - t0
+    assert batch is not None and len(batch) == 1
+    assert 0.005 <= dt < 2.0, dt
+
+
+def test_closed_queue_flushes_remainder_then_signals_exit():
+    clock = FakeClock()
+    q = RequestQueue(max_depth=8, clock=clock)
+    b = Batcher(q, max_batch=4, clock=clock)
+    q.submit(_cred(), [0], max_wait_ms=10_000)
+    q.close()
+    batch = b.next_batch(block=True)  # no deadline wait: close flushes
+    assert batch is not None and len(batch) == 1
+    assert b.next_batch(block=True) is None  # closed + empty: exit signal
+
+
+def test_pad_batch_identity_lanes_to_cache_hot_shape():
+    clock = FakeClock()
+    q = RequestQueue(max_depth=8, clock=clock)
+    q.submit(_cred(), [7, 8], max_wait_ms=0)
+    q.submit(_cred(), [9, 10], max_wait_ms=0)
+    batch = Batcher(q, max_batch=8, clock=clock).next_batch(block=False)
+    sigs, messages_list, n_pad = pad_batch(batch, 8)
+    assert len(sigs) == len(messages_list) == 8 and n_pad == 6
+    assert all(s.sigma_1 is None and s.sigma_2 is None for s in sigs[2:])
+    # pad rows reuse a real message vector, so per-lane shape is unchanged
+    assert all(m == [7, 8] for m in messages_list[2:])
+    assert metrics.get_count("serve_pad_lanes") == 6
+
+
+# --- service: end-to-end demux, padding, lifecycle --------------------------
+
+
+def test_service_demux_per_credential_exactly_forged_future_fails():
+    be = StubPerCred()
+    with _service(be) as svc:
+        futs = [
+            svc.submit(_cred(ok=(i != 2)), [i]) for i in range(6)
+        ]
+    verdicts = [f.result(5.0) for f in futs]
+    assert verdicts == [True, True, False, True, True, True]
+    assert metrics.get_count("serve_valid") == 5
+    assert metrics.get_count("serve_invalid") == 1
+    snap = metrics.snapshot()["histograms"]["serve_latency_s"]
+    assert snap["count"] == 6 and snap["p99_s"] is not None
+
+
+def test_service_pads_partial_batches_to_constant_shape():
+    be = StubPerCred()
+    with _service(be, max_batch=8) as svc:
+        futs = [svc.submit(_cred(), [0]) for _ in range(3)]
+    assert [f.result(5.0) for f in futs] == [True] * 3
+    # every dispatched program saw the SAME shape: jit stays cache-hot
+    assert be.batch_sizes and set(be.batch_sizes) == {8}
+    assert metrics.get_count("serve_pad_lanes") >= 5
+
+
+def test_service_drain_resolves_every_inflight_future():
+    be = GatedPerCred()
+    svc = _service(be, max_batch=4, max_depth=64).start()
+    futs = [svc.submit(_cred(), [i]) for i in range(11)]
+    assert be.entered.wait(5.0)
+    be.release.set()
+    assert svc.drain(timeout=10.0)
+    assert all(f.done() for f in futs)
+    assert [f.result(0) for f in futs] == [True] * 11
+
+
+def test_service_admission_control_live_then_recovers():
+    # long deadline so the gated pair flushes as ONE full batch and the
+    # backlog sits untouched while the supervisor is held at the gate
+    be = GatedPerCred()
+    svc = _service(be, max_batch=2, max_depth=3, max_wait_ms=5_000.0).start()
+    first = [svc.submit(_cred(), [i]) for i in range(2)]
+    assert be.entered.wait(5.0)  # supervisor holds these two in flight
+    backlog = [svc.submit(_cred(), [i]) for i in range(3)]
+    with pytest.raises(ServiceOverloadedError):
+        svc.submit(_cred(), [99])
+    assert metrics.get_count("serve_rejected") == 1
+    be.release.set()
+    assert svc.drain(timeout=10.0)
+    assert [f.result(0) for f in first + backlog] == [True] * 5
+
+
+def test_service_shutdown_without_drain_fails_queued_typed():
+    be = GatedPerCred()
+    svc = _service(be, max_batch=2, max_depth=64, max_wait_ms=5_000.0).start()
+    inflight = [svc.submit(_cred(), [i]) for i in range(2)]
+    assert be.entered.wait(5.0)
+    queued = [svc.submit(_cred(), [i]) for i in range(3)]
+
+    # release the gate only after shutdown() has swept the backlog (the
+    # supervisor is held inside the in-flight batch until then), so the
+    # queued futures deterministically cancel instead of completing
+    def _release_when_swept():
+        while svc.depth() > 0:
+            time.sleep(0.001)
+        be.release.set()
+
+    releaser = threading.Thread(target=_release_when_swept)
+    releaser.start()
+    assert svc.shutdown(drain=False, timeout=10.0)
+    releaser.join(5.0)
+    assert [f.result(5.0) for f in inflight] == [True, True]
+    for f in queued:
+        assert isinstance(f.exception(5.0), ServiceClosedError)
+    assert metrics.get_count("serve_cancelled") == 3
+    with pytest.raises(ServiceClosedError):
+        svc.submit(_cred(), [0])
+
+
+def test_service_batch_failure_fails_only_that_batchs_futures():
+    # permanent (non-retryable) fault on the FIRST dispatch only: its
+    # cohabitants resolve exceptionally, the next batch is unaffected
+    be = FaultyBackend(StubPerCred(), raise_on={0}, error=RuntimeError)
+    svc = _service(be, max_batch=2).start()
+    bad = [svc.submit(_cred(), [i]) for i in range(2)]
+    for f in bad:
+        assert isinstance(f.exception(5.0), RuntimeError)
+    good = [svc.submit(_cred(), [i]) for i in range(2)]
+    svc.drain(timeout=10.0)
+    assert [f.result(0) for f in good] == [True, True]
+    assert metrics.get_count("serve_failed_requests") == 2
+
+
+def test_service_retry_ladder_recovers_transient_dispatch_fault():
+    be = FaultyBackend(StubPerCred(), raise_on={0})
+    with _service(be, retry_policy=_policy()) as svc:
+        futs = [svc.submit(_cred(), [i]) for i in range(2)]
+    assert [f.result(5.0) for f in futs] == [True, True]
+    assert metrics.get_count("retries") >= 1
+
+
+def test_service_falls_back_after_retries_exhaust():
+    be = FaultyBackend(StubPerCred(), raise_every=1)  # primary always dies
+    with _service(
+        be,
+        retry_policy=_policy(max_attempts=2),
+        fallback_backend=StubPerCred(),
+    ) as svc:
+        futs = [svc.submit(_cred(ok=(i != 1)), [i]) for i in range(3)]
+    assert [f.result(5.0) for f in futs] == [True, False, True]
+    assert metrics.get_count("fallbacks") >= 1
+
+
+# --- the demux invariant (ISSUE satellite): grouped + bisection -------------
+
+
+def test_grouped_demux_invariant_one_forged_one_dead_letter(tmp_path):
+    dlq = str(tmp_path / "serve_dead.jsonl")
+    be = StubGrouped()
+    svc = _service(
+        be, mode="grouped", dead_letter_path=dlq, retry_policy=_policy()
+    ).start()
+    futs = [svc.submit(_cred(ok=(i != 2)), [i]) for i in range(4)]
+    assert svc.drain(timeout=10.0)
+    # exactly the forged request's future resolves invalid...
+    assert [f.result(0) for f in futs] == [True, True, False, True]
+    # ...and exactly it is dead-lettered, keyed by batch seq + lane index
+    records = DeadLetterLog.read(dlq)
+    assert len(records) == 1
+    assert records[0]["batch"] == 0 and records[0]["credential"] == 2
+    assert metrics.get_count("dead_letters") == 1
+    assert metrics.get_count("bisections") >= 1
+
+
+def test_grouped_demux_invariant_across_transient_retry_ladder(tmp_path):
+    # the coalesced batch's FIRST dispatch raises transiently, and so does
+    # the first bisection probe: the retry ladder rides through both and
+    # the demux invariant still holds exactly
+    dlq = str(tmp_path / "serve_dead_retry.jsonl")
+    be = FaultyBackend(StubGrouped(), raise_on={0, 2})
+    svc = _service(
+        be,
+        mode="grouped",
+        dead_letter_path=dlq,
+        retry_policy=_policy(max_attempts=3),
+    ).start()
+    futs = [svc.submit(_cred(ok=(i != 1)), [i]) for i in range(4)]
+    assert svc.drain(timeout=10.0)
+    assert [f.result(0) for f in futs] == [True, False, True, True]
+    records = DeadLetterLog.read(dlq)
+    assert len(records) == 1
+    assert records[0]["batch"] == 0 and records[0]["credential"] == 1
+    # the batch's transient dispatch fault is in the attempt history
+    assert records[0]["attempts"] and records[0]["attempts"][0]["error"] == (
+        "TransientBackendError"
+    )
+    assert metrics.get_count("retries") >= 1
+
+
+def test_grouped_all_valid_no_bisection_no_dead_letters(tmp_path):
+    dlq = str(tmp_path / "serve_dead_clean.jsonl")
+    be = StubGrouped()
+    with _service(be, mode="grouped", dead_letter_path=dlq) as svc:
+        futs = [svc.submit(_cred(), [i]) for i in range(5)]
+    assert all(f.result(5.0) for f in futs)
+    assert DeadLetterLog.read(dlq) == []
+    assert metrics.get_count("bisections") == 0
+
+
+# --- metrics satellites -----------------------------------------------------
+
+
+def test_histogram_percentiles_and_bounded_window():
+    for ms in range(1, 101):
+        metrics.observe("lat", ms / 1000.0)
+    h = metrics.snapshot()["histograms"]["lat"]
+    assert h["count"] == 100
+    assert h["p50_s"] == pytest.approx(0.050)
+    assert h["p95_s"] == pytest.approx(0.095)
+    assert h["p99_s"] == pytest.approx(0.099)
+    assert h["max_s"] == pytest.approx(0.100)
+    assert h["mean_s"] == pytest.approx(0.0505)
+    # bounded: a long run retains a window but exact count/max
+    for _ in range(2 * metrics.HIST_WINDOW):
+        metrics.observe("lat", 0.001)
+    h = metrics.snapshot()["histograms"]["lat"]
+    assert h["count"] == 100 + 2 * metrics.HIST_WINDOW
+    assert h["max_s"] == pytest.approx(0.100)  # exact over the full run
+    assert h["p99_s"] == pytest.approx(0.001)  # window: recent behavior
+
+
+def test_metrics_mutations_are_thread_safe():
+    n_threads, n_iter = 8, 2000
+
+    def work():
+        for _ in range(n_iter):
+            metrics.count("ts_smoke")
+            metrics.observe("ts_hist", 0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert metrics.get_count("ts_smoke") == n_threads * n_iter
+    assert (
+        metrics.snapshot()["histograms"]["ts_hist"]["count"]
+        == n_threads * n_iter
+    )
+
+
+# --- faults satellite: deterministic latency injection ----------------------
+
+
+def test_faultybackend_latency_injection_is_deterministic():
+    slept = []
+    inner = StubPerCred()
+    be = FaultyBackend(
+        inner, delay_every=2, delay_on={4}, delay_s=1.5, sleep=slept.append
+    )
+    for _ in range(6):
+        be.batch_verify([_cred()], [[0]], None, None)
+    # delay_every=2 hits indices 1,3,5; delay_on adds 4 — never 0 or 2
+    assert slept == [1.5, 1.5, 1.5, 1.5]
+    assert inner.batch_sizes == [1] * 6  # delays never drop dispatches
+    # same schedule, fresh wrapper: bitwise-identical injection
+    slept2 = []
+    be2 = FaultyBackend(
+        inner, delay_every=2, delay_on={4}, delay_s=1.5, sleep=slept2.append
+    )
+    for _ in range(6):
+        be2.batch_verify([_cred()], [[0]], None, None)
+    assert slept2 == slept
+
+
+def test_faultybackend_delay_then_fault_compose():
+    slept = []
+    be = FaultyBackend(
+        StubPerCred(),
+        raise_on={1},
+        delay_on={0, 1},
+        delay_s=0.25,
+        sleep=slept.append,
+    )
+    be.batch_verify([_cred()], [[0]], None, None)  # idx 0: slow, succeeds
+    with pytest.raises(TransientBackendError):
+        be.batch_verify([_cred()], [[0]], None, None)  # idx 1: fails fast
+    # the dispatch-time fault preempts the sleep (a dead device does not
+    # also get slower): only the first dispatch slept
+    assert slept == [0.25]
+
+
+# --- loadgen ----------------------------------------------------------------
+
+
+def test_loadgen_closed_loop_zero_dropped_and_sane_report():
+    be = StubPerCred()
+    svc = _service(be, max_batch=4, max_depth=256).start()
+    pool = [(_cred(), [0], True), (_cred(ok=False), [1], False)]
+    report = run_loadgen(
+        svc, pool, duration_s=0.25, arrival="closed", concurrency=4
+    )
+    assert svc.drain(timeout=10.0)
+    assert report["dropped_futures"] == 0
+    assert report["errors"] == 0
+    assert report["verdict_mismatches"] == 0
+    assert report["completed"] > 0
+    assert report["completed"] == report["valid"] + report["invalid"]
+    assert report["latency_s"]["p99"] is not None
+    assert report["latency_s"]["p50"] <= report["latency_s"]["p99"]
+    assert report["goodput_per_s"] > 0
+    assert report["mean_batch_occupancy"] is not None
+    assert 0.0 < report["mean_batch_occupancy"] <= 1.0
+
+
+def test_loadgen_open_loop_poisson_arrivals():
+    be = StubPerCred()
+    svc = _service(be, max_batch=4, max_depth=256).start()
+    pool = [(_cred(), [0], True)]
+    report = run_loadgen(
+        svc,
+        pool,
+        duration_s=0.15,
+        arrival="open",
+        rate_per_s=400.0,
+    )
+    assert svc.drain(timeout=10.0)
+    assert report["dropped_futures"] == 0 and report["errors"] == 0
+    assert report["submitted"] > 0
+    assert report["rejection_rate"] in (0.0, None) or (
+        0.0 <= report["rejection_rate"] <= 1.0
+    )
+
+
+def test_loadgen_reports_rejections_under_overload():
+    # tiny admission bound + gated backend: the closed loop must observe
+    # typed rejections, count them, and still drop zero futures
+    be = GatedPerCred()
+    svc = _service(be, max_batch=2, max_depth=2, max_wait_ms=0.0).start()
+    pool = [(_cred(), [0], True)]
+    t = threading.Timer(0.15, be.release.set)
+    t.start()
+    report = run_loadgen(
+        svc, pool, duration_s=0.1, arrival="closed", concurrency=6
+    )
+    assert svc.drain(timeout=10.0)
+    t.cancel()
+    assert report["rejected"] > 0
+    assert report["rejection_rate"] > 0
+    assert report["dropped_futures"] == 0
