@@ -76,7 +76,10 @@ def bench_serve(ge, params, vk, sigs, msgs_list, extras, backend_name):
     """Online-serving lane: closed-loop loadgen at saturation against the
     dynamic-batching CredentialService; embeds the SLO report (p50/p95/p99
     latency, goodput, mean batch occupancy, rejection counts) under
-    extras["serve"]. Returns the goodput (requests/sec)."""
+    extras["serve"], plus a tracing-overhead probe (goodput with
+    COCONUT_TRACE off vs on, BENCH_TRACE_OVERHEAD=0 to skip) under
+    extras["serve"]["trace_overhead"]. Returns the goodput
+    (requests/sec)."""
     from coconut_tpu.serve import CredentialService, run_loadgen
     from coconut_tpu.signature import Signature
 
@@ -126,6 +129,38 @@ def bench_serve(ge, params, vk, sigs, msgs_list, extras, backend_name):
             arrival="closed",
             concurrency=concurrency,
         )
+        trace_overhead = None
+        if os.environ.get("BENCH_TRACE_OVERHEAD", "1") == "1":
+            # tracing-overhead probe (ISSUE 6 acceptance: enabled-tracing
+            # goodput within ~5% of disabled): two short back-to-back
+            # closed-loop passes against the SAME warm service, tracing
+            # off then on. Reported, not asserted — sub-second CPU lanes
+            # are too noisy for a hard gate, the BENCH JSON is the audit
+            # surface. BENCH_TRACE_OVERHEAD=0 skips.
+            from coconut_tpu.obs import trace as otrace
+
+            t_secs = float(os.environ.get("BENCH_TRACE_SECONDS", "1"))
+            was_enabled = otrace.enabled()
+            otrace.disable()
+            off = run_loadgen(
+                svc, pool, duration_s=t_secs,
+                arrival="closed", concurrency=concurrency,
+            )
+            otrace.enable()
+            on = run_loadgen(
+                svc, pool, duration_s=t_secs,
+                arrival="closed", concurrency=concurrency,
+            )
+            if not was_enabled:
+                otrace.disable()
+            off_g, on_g = off["goodput_per_s"], on["goodput_per_s"]
+            trace_overhead = {
+                "off_goodput_per_s": off_g,
+                "on_goodput_per_s": on_g,
+                "overhead_frac": (
+                    round((off_g - on_g) / off_g, 4) if off_g else None
+                ),
+            }
     assert report["dropped_futures"] == 0, (
         "serve lane dropped futures: %r" % (report,)
     )
@@ -142,6 +177,7 @@ def bench_serve(ge, params, vk, sigs, msgs_list, extras, backend_name):
         "max_batch": max_batch,
         "max_wait_ms": max_wait_ms,
         **report,
+        "trace_overhead": trace_overhead,
     }
     return report["goodput_per_s"]
 

@@ -48,6 +48,10 @@ grep -q '"batch": 1' "$DLQ"
 grep -q '"credential": 2' "$DLQ"
 grep -q '"reason"' "$DLQ"
 grep -q '"attempts"' "$DLQ"
+# schema v2: every line carries trace join keys (null with tracing off)
+grep -q '"schema": 2' "$DLQ"
+grep -q '"trace_id"' "$DLQ"
+grep -q '"span_id"' "$DLQ"
 echo "dead-letter schema: ok"
 
 echo "== serve lane (dynamic batching / admission control / loadgen) =="
@@ -75,6 +79,60 @@ print("serve smoke: ok (goodput %.1f/s, occupancy %.2f, p99 %.0f ms)" % (
     report["goodput_per_s"], report["mean_batch_occupancy"],
     report["latency_s"]["p99"] * 1000.0))
 EOF
+
+echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
+python -m pytest tests/test_obs.py -m obs -q
+# end-to-end acceptance smoke on the REAL service (CPU, stub backend):
+# one injected dispatch fault + one forged credential, tracing enabled.
+# The forged request's span tree must show admission -> coalesce ->
+# dispatch -> retry -> bisection -> dead-letter, its trace_id must appear
+# in the dead-letter JSONL line AND the flight record, and the Chrome
+# trace export must pass probe_trace's structural validation.
+OBS_DIR=$(mktemp -d)
+OBS_DLQ="$OBS_DIR/dead.jsonl" OBS_TRACE="$OBS_DIR/trace.json" python - <<'EOF'
+import os
+from types import SimpleNamespace
+from coconut_tpu.faults import DeadLetterLog, FaultyBackend
+from coconut_tpu.obs import export, flight
+from coconut_tpu.obs import trace as otrace
+from coconut_tpu.retry import RetryPolicy
+from coconut_tpu.serve.service import CredentialService
+
+def cred(ok=True):
+    return SimpleNamespace(sigma_1=1, sigma_2=1, ok=ok)
+
+class Grouped:
+    def batch_verify_grouped(self, sigs, msgs, vk, params):
+        return all(s.sigma_1 is not None and s.ok for s in sigs)
+
+otrace.enable()
+dlq = os.environ["OBS_DLQ"]
+svc = CredentialService(
+    FaultyBackend(Grouped(), raise_on={0}), None, None, mode="grouped",
+    max_batch=4, retry_policy=RetryPolicy(max_attempts=2, base_delay=0.0),
+    dead_letter_path=dlq)
+with svc:
+    futs = [svc.submit(cred(ok=(i != 2)), [0], max_wait_ms=100.0)
+            for i in range(4)]
+    verdicts = [f.result(30.0) for f in futs]
+assert verdicts == [True, True, False, True], verdicts
+(rec,) = DeadLetterLog.read(dlq)
+assert rec["schema"] == 2 and rec["trace_id"] == futs[2].trace_id, rec
+tree = otrace.get_tracer().spans_for(futs[2].trace_id)
+names = {s.name for s in tree}
+assert names >= {"request", "queue_wait", "batch", "coalesce", "dispatch",
+                 "device", "bisect", "demux"}, names
+events = {e["name"] for s in tree for e in s.events}
+assert {"retry", "attempt_failed", "split", "dead_letter"} <= events, events
+(fl,) = flight.read(dlq)
+assert fl["trace_id"] == futs[2].trace_id and fl["reason"] == "dead_letter"
+n = export.export_chrome(os.environ["OBS_TRACE"])
+assert n > 0
+print("obs smoke: ok (%d trace events, culprit trace %s)"
+      % (n, rec["trace_id"]))
+EOF
+JAX_PLATFORMS=cpu python probes/probe_trace.py "$OBS_DIR/trace.json"
+test -f "$OBS_DIR/dead.jsonl.flight.jsonl"
 
 echo "== encode-pipeline lane (prefetch worker / static cache / raw wire) =="
 # lean by construction: only host-side / small-jit tests carry the

@@ -26,6 +26,16 @@ path:
     a reason, and the batch's retry attempt history. JSONL so a ledger
     operator can grep/stream it without loading a document; ci.sh greps
     the schema as a smoke check.
+
+    Schema v2 (request-scoped tracing): entries carry `trace_id` /
+    `span_id` so a dead-letter line joins back to its span tree (the
+    serve path passes the CULPRIT request's trace_id; the offline stream
+    defaults both to the active bisection span). Both are null with
+    tracing disabled, and v1 files (no trace fields) read back with the
+    fields normalized to null — old logs stay parseable. Each append
+    also triggers the flight recorder (obs/flight.py): the failing
+    trace's span tree plus the recent-span tail land in
+    `<path>.flight.jsonl` next to this log.
 """
 
 import json
@@ -33,6 +43,11 @@ import os
 import time
 
 from .errors import TransientBackendError
+from .obs import flight as _flight
+from .obs import trace as otrace
+
+#: dead-letter JSONL schema: v2 added trace_id/span_id (absent -> null)
+DEAD_LETTER_SCHEMA = 2
 
 # the verify entry points verify_stream._dispatchers probes for; faults are
 # injected only on these, everything else delegates untouched
@@ -169,29 +184,62 @@ class FaultyBackend:
 class DeadLetterLog:
     """Append-only JSONL sink for credentials the stream could not accept.
 
-    One object per line, keys sorted for grep-ability:
-      {"attempts": [...], "batch": int, "credential": int, "reason": str}
-    where `credential` is the index WITHIN the batch and `attempts` is the
-    batch's retry attempt history (retry.note_attempt records)."""
+    One object per line, keys sorted for grep-ability (schema v2):
+      {"attempts": [...], "batch": int, "credential": int, "reason": str,
+       "schema": 2, "span_id": int|null, "trace_id": str|null}
+    where `credential` is the index WITHIN the batch, `attempts` is the
+    batch's retry attempt history (retry.note_attempt records), and
+    trace_id/span_id join the line to its request's span tree (null with
+    tracing disabled)."""
 
     def __init__(self, path):
         self.path = path
 
-    def append(self, batch, credential, reason, attempts=()):
+    def append(
+        self, batch, credential, reason, attempts=(), trace_id=None, span_id=None
+    ):
+        """Append one culprit record. trace_id/span_id default to the
+        ACTIVE span's (the bisection span, within the batch trace) when
+        tracing is enabled; the serve path overrides trace_id with the
+        culprit request's own. Triggers a flight-recorder dump for the
+        recorded trace."""
+        cur = otrace.current()
+        if cur is not None:
+            if trace_id is None:
+                trace_id = cur.trace_id
+            if span_id is None:
+                span_id = cur.span_id
         rec = {
+            "schema": DEAD_LETTER_SCHEMA,
             "batch": int(batch),
             "credential": int(credential),
             "reason": reason,
             "attempts": list(attempts),
+            "trace_id": trace_id,
+            "span_id": span_id,
         }
         with open(self.path, "a") as f:
             f.write(json.dumps(rec, sort_keys=True) + "\n")
+        _flight.record(
+            self.path,
+            "dead_letter",
+            trace_id=trace_id,
+            extra={"batch": rec["batch"], "credential": rec["credential"]},
+        )
         return rec
 
     @staticmethod
     def read(path):
-        """All records in `path` (empty list if it does not exist)."""
+        """All records in `path` (empty list if it does not exist).
+        Pre-v2 records are normalized on read: absent trace fields become
+        null, absent schema becomes 1 — readers never need per-version
+        key checks."""
         if not os.path.exists(path):
             return []
         with open(path) as f:
-            return [json.loads(line) for line in f if line.strip()]
+            recs = [json.loads(line) for line in f if line.strip()]
+        for rec in recs:
+            rec.setdefault("schema", 1)
+            rec.setdefault("trace_id", None)
+            rec.setdefault("span_id", None)
+        return recs

@@ -44,6 +44,13 @@ so a million-request serving run holds kilobytes, not a sample per request.
 Percentiles in `snapshot()` are therefore over the retained window — recent
 behavior, which is what an SLO monitor wants anyway.
 
+Request-scoped observability is separate but joins here: while tracing is
+enabled (coconut_tpu/obs, COCONUT_TRACE=1) `snapshot()` embeds a
+"trace_stages" section — per-span-name count/total/mean, the queue-wait /
+coalesce / encode / device / demux breakdown that separates "slow device"
+from "slow batcher" — via `register_provider`, so this module never
+imports obs (providers are injected, not imported).
+
 Device-side profiling is separate: the hot kernels in tpu/backend.py carry
 `jax.named_scope` annotations (comb_msm, grouped_tables /
 grouped_gather_fold / grouped_horner, miller_two_pairs / grouped_miller,
@@ -61,6 +68,7 @@ _lock = threading.RLock()
 _timers = defaultdict(float)
 _counts = defaultdict(int)
 _hists = {}
+_providers = {}  # snapshot section name -> zero-arg callable
 
 # per-histogram retained-sample window (memory bound; count/total/max stay
 # exact over the full run)
@@ -114,8 +122,19 @@ def observe(name, seconds):
 
 def percentile(samples, q):
     """q-th percentile (q in [0, 100]) of `samples` by the nearest-rank
-    method; None on an empty list. Small-n honest: p99 of 10 samples is
-    the max, not an interpolated fiction."""
+    method. Tiny-window behavior is PINNED, not emergent:
+
+      n == 0  ->  None (there is no sample to report — never a fabricated
+                  zero);
+      n == 1  ->  the single sample, for EVERY q including 0 and 100;
+      q outside [0, 100] -> ValueError (previously q=-5 silently read the
+                  min and q=200 the max — a caller bug masquerading as a
+                  statistic).
+
+    Small-n honest in general: p99 of 10 samples is the max, not an
+    interpolated fiction."""
+    if not 0.0 <= q <= 100.0:
+        raise ValueError("percentile q must be in [0, 100] (got %r)" % (q,))
     if not samples:
         return None
     import math
@@ -125,24 +144,50 @@ def percentile(samples, q):
     return s[rank]
 
 
+def percentile_summary(samples, qs=(50, 95, 99)):
+    """{"p50": ..., ...} nearest-rank readout with the tiny-window policy
+    of `percentile` made structural: n=0 returns an EMPTY dict (absent
+    keys, not None-or-zero values), n=1 returns the single sample under
+    every requested quantile."""
+    if not samples:
+        return {}
+    return {"p%g" % q: percentile(samples, q) for q in qs}
+
+
 def _hist_readout(h):
     window = list(h["window"])
     n = h["count"]
+    ps = percentile_summary(window)
     return {
         "count": n,
         "mean_s": round(h["total"] / n, 6) if n else None,
-        "p50_s": round(percentile(window, 50), 6) if window else None,
-        "p95_s": round(percentile(window, 95), 6) if window else None,
-        "p99_s": round(percentile(window, 99), 6) if window else None,
+        "p50_s": round(ps["p50"], 6) if ps else None,
+        "p95_s": round(ps["p95"], 6) if ps else None,
+        "p99_s": round(ps["p99"], 6) if ps else None,
         "max_s": round(h["max"], 6),
     }
 
 
+def register_provider(name, fn):
+    """Register a zero-arg callable whose result snapshot() embeds under
+    `name` — how obs.trace contributes the per-stage span breakdown
+    without this module importing it."""
+    with _lock:
+        _providers[name] = fn
+
+
+def unregister_provider(name):
+    with _lock:
+        _providers.pop(name, None)
+
+
 def snapshot():
-    """{"timers_s": {...}, "counters": {...}[, "histograms": {...}]} —
-    current totals; histogram readouts (count / mean / p50 / p95 / p99 /
-    max over the retained window) appear once anything has been
-    observe()d."""
+    """{"timers_s": {...}, "counters": {...}[, "histograms": {...}]
+    [, <provider sections>]} — current totals; histogram readouts
+    (count / mean / p50 / p95 / p99 / max over the retained window)
+    appear once anything has been observe()d; provider sections (e.g.
+    "trace_stages" while tracing is enabled) appear while registered and
+    non-empty."""
     with _lock:
         snap = {
             "timers_s": {k: round(v, 6) for k, v in sorted(_timers.items())},
@@ -152,7 +197,13 @@ def snapshot():
             snap["histograms"] = {
                 k: _hist_readout(h) for k, h in sorted(_hists.items())
             }
-        return snap
+        providers = list(_providers.items())
+    # provider callables run OUTSIDE the lock (they may take their own)
+    for name, fn in providers:
+        section = fn()
+        if section:
+            snap[name] = section
+    return snap
 
 
 def reset():

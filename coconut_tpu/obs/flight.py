@@ -1,0 +1,80 @@
+"""Fault flight recorder: dump the trace context of a failure the moment
+it happens.
+
+The tracer's ring buffer forgets (bounded memory is the point), so by the
+time an operator greps a dead-letter line the spans that explain it may
+be gone. This module closes that gap: on a dead-letter append or a
+checkpoint quarantine, `record()` writes ONE JSONL line holding
+
+  - the failing request's FULL span tree (finished + still-live spans of
+    its trace, plus the batch trace linked via the `batch_trace`
+    attribute — the request->batch join the serve layer records), and
+  - the last `last_n` completed spans overall (what the system was doing
+    just before the fault — the classic flight-recorder tail),
+
+to `<base>.flight.jsonl` next to the artifact that triggered it (the
+dead-letter log, the checkpoint file). Joining back is one grep: the
+dead-letter line and the flight line share the trace_id.
+
+Zero-cost when tracing is disabled: `record()` returns None without
+touching the filesystem. Failures to WRITE the flight record are
+swallowed (`flight_write_errors` counter) — the recorder must never turn
+a handled fault into a crash.
+"""
+
+import json
+import time
+
+from .. import metrics
+from . import trace as _trace
+from .export import span_records
+
+FLIGHT_SCHEMA = 1
+
+#: completed-span tail included in every flight record
+DEFAULT_LAST_N = 64
+
+
+def flight_path(base_path):
+    """The flight-recorder file that rides next to `base_path`."""
+    return "%s.flight.jsonl" % (base_path,)
+
+
+def record(base_path, reason, trace_id=None, extra=None, last_n=DEFAULT_LAST_N):
+    """Append one flight record next to `base_path`; returns the record
+    (None when tracing is disabled or base_path is falsy)."""
+    tracer = _trace.get_tracer()
+    if tracer is None or not base_path:
+        return None
+    tree = tracer.spans_for(trace_id) if trace_id is not None else []
+    rec = {
+        "schema": FLIGHT_SCHEMA,
+        "wall_time": time.time(),
+        "reason": reason,
+        "trace_id": trace_id,
+        "tree": span_records(tree),
+        "recent": span_records(tracer.tail(last_n)),
+    }
+    if extra:
+        rec.update(extra)
+    try:
+        with open(flight_path(base_path), "a") as f:
+            f.write(json.dumps(rec, sort_keys=True) + "\n")
+    except OSError:
+        metrics.count("flight_write_errors")
+        return None
+    metrics.count("flight_records")
+    return rec
+
+
+def read(path):
+    """All flight records in `path` (empty list if it does not exist) —
+    accepts either the base path or the .flight.jsonl path itself."""
+    import os
+
+    if not path.endswith(".flight.jsonl"):
+        path = flight_path(path)
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]

@@ -11,6 +11,12 @@ re-dispatches.
 
 Counters (metrics.py): "retries" increments per re-attempt, "fallbacks"
 per degradation to the fallback backend.
+
+Tracing (coconut_tpu/obs): when a span is active, the ladder narrates
+itself onto it — "retry" (with the backoff chosen) per re-attempt,
+"attempt_failed" (with the error class) per transient failure, and
+"fallback" when the ladder degrades — so a single request's trace shows
+its exact attempt history, not just the run-wide counters.
 """
 
 import time
@@ -18,6 +24,7 @@ import zlib
 
 from . import metrics
 from .errors import TransientBackendError
+from .obs import trace as otrace
 
 
 class RetryPolicy:
@@ -89,14 +96,24 @@ def call_with_retry(fn, policy, key=0, attempts=None, fallback=None):
     while len(attempts) < policy.max_attempts:
         if attempts:
             metrics.count("retries")
-            policy.sleep(policy.backoff(len(attempts), key=key))
+            delay = policy.backoff(len(attempts), key=key)
+            otrace.event(
+                "retry", attempt=len(attempts) + 1, backoff_s=round(delay, 6)
+            )
+            policy.sleep(delay)
         try:
             return fn()
         except policy.retryable as e:
             last = e
             note_attempt(attempts, e)
+            otrace.event(
+                "attempt_failed",
+                attempt=len(attempts),
+                error=type(e).__name__,
+            )
     if fallback is not None:
         metrics.count("fallbacks")
+        otrace.event("fallback", after_attempts=len(attempts))
         return fallback()
     if last is None:
         # every attempt was consumed by the caller before we ran

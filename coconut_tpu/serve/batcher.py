@@ -34,6 +34,7 @@ deadline tests advance time explicitly and never sleep.
 import time
 
 from .. import metrics
+from ..obs import trace as otrace
 from .queue import LANES  # noqa: F401  (re-export for callers)
 
 #: cap on any single condition wait: keeps the batcher responsive to fake
@@ -96,6 +97,11 @@ class Batcher:
                     batch = q._pop_locked(self.max_batch)
                     metrics.count("serve_batches")
                     metrics.count("serve_batched_requests", len(batch))
+                    for req in batch:
+                        # queue_wait ends the moment the request is IN a
+                        # coalesced batch — its dur is the admission->
+                        # flush latency the per-stage breakdown reports
+                        req.queue_span.end(coalesced_with=len(batch))
                     return batch
                 if q.closed and q._depth_locked() == 0:
                     return None
@@ -119,28 +125,39 @@ def pad_batch(requests, max_batch):
         sigs.extend([PAD_CREDENTIAL] * n_pad)
         messages_list.extend([list(requests[0].messages)] * n_pad)
         metrics.count("serve_pad_lanes", n_pad)
+        # annotate the active (coalesce) span so a padded flush is
+        # visible per-batch in the trace, not only in aggregate
+        otrace.event("pad_lanes", n=n_pad)
     return sigs, messages_list, n_pad
 
 
 def demux(requests, bits, clock=time.monotonic):
     """Resolve each request's future with its own lane's verdict bit
     (padding lanes beyond len(requests) are ignored), recording the
-    per-request latency histogram and verdict counters."""
-    now = clock()
-    n_valid = 0
-    for req, bit in zip(requests, bits):
-        ok = bool(bit)
-        n_valid += ok
-        metrics.observe("serve_latency_s", now - req.t_submit)
-        req.future.set_result(ok)
-    metrics.count("serve_valid", n_valid)
-    metrics.count("serve_invalid", len(requests) - n_valid)
+    per-request latency histogram and verdict counters. Each request's
+    root span ends here, stamped with its verdict — the trace covers
+    admission through verdict delivery."""
+    with otrace.span("demux", n=len(requests)):
+        now = clock()
+        n_valid = 0
+        for req, bit in zip(requests, bits):
+            ok = bool(bit)
+            n_valid += ok
+            metrics.observe("serve_latency_s", now - req.t_submit)
+            req.span.end(verdict=ok)
+            req.future.set_result(ok)
+        metrics.count("serve_valid", n_valid)
+        metrics.count("serve_invalid", len(requests) - n_valid)
 
 
 def fail_all(requests, exc, counter="serve_failed_requests"):
     """Resolve every request's future with `exc` (the batch-level failure
-    and shutdown paths) — a future must never be left dangling."""
+    and shutdown paths) — a future must never be left dangling. Request
+    spans (root + a possibly still-open queue_wait) end with the error
+    class, so abandoned requests are visible in the trace, not dropped."""
     for req in requests:
+        req.queue_span.end()
+        req.span.end(error=type(exc).__name__)
         req.future.set_exception(exc)
     if requests:
         metrics.count(counter, len(requests))

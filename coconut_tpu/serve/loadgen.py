@@ -23,7 +23,12 @@ futures that never resolved (must be 0 — the service guarantees it) and
 The report embeds client-observed p50/p95/p99/mean/max latency, goodput
 (verdicts delivered per second of wall), mean batch occupancy
 (coalesced requests per flushed batch / max_batch, from the metrics
-counters' delta over the run), and the admission rejection rate.
+counters' delta over the run), and the admission rejection rate. With
+tracing enabled (COCONUT_TRACE=1) it also embeds `stage_breakdown_s` —
+the per-stage span totals accumulated DURING the run (queue_wait /
+coalesce / dispatch / device / demux), which finally separates "slow
+device" from "slow batcher" for the same requests the latency
+percentiles describe; null when tracing is off.
 
 Determinism knobs: `rng` (arrival jitter + pool sampling), `clock`, and
 `sleep` are injectable, so tests can drive the generator without
@@ -36,6 +41,39 @@ import time
 
 from .. import metrics
 from ..errors import ServiceClosedError, ServiceOverloadedError
+from ..obs import trace as otrace
+
+
+def _stage_totals():
+    """{span name: (count, total_s)} snapshot, or None when tracing is
+    off — the loadgen reports the DELTA over its run."""
+    tracer = otrace.get_tracer()
+    if tracer is None:
+        return None
+    return {
+        name: (s["count"], s["total_s"])
+        for name, s in tracer.stage_summary().items()
+    }
+
+
+def _stage_delta(before, after):
+    """Per-stage {count, total_s, mean_s} accumulated between two
+    _stage_totals snapshots."""
+    if after is None:
+        return None
+    before = before or {}
+    out = {}
+    for name, (count, total) in sorted(after.items()):
+        c0, t0 = before.get(name, (0, 0.0))
+        dc, dt = count - c0, total - t0
+        if dc <= 0:
+            continue
+        out[name] = {
+            "count": dc,
+            "total_s": round(dt, 6),
+            "mean_s": round(dt / dc, 6),
+        }
+    return out
 
 
 def _percentiles(latencies):
@@ -115,6 +153,7 @@ def run_loadgen(
     tally = _Tally()
     occ0_reqs = metrics.get_count("serve_batched_requests")
     occ0_batches = metrics.get_count("serve_batches")
+    stages0 = _stage_totals()
     t0 = clock()
     t_end = t0 + duration_s
 
@@ -182,6 +221,7 @@ def run_loadgen(
         "invalid": tally.invalid,
         "verdict_mismatches": tally.mismatches,
         "latency_s": _percentiles(tally.latencies),
+        "stage_breakdown_s": _stage_delta(stages0, _stage_totals()),
         "goodput_per_s": round(tally.completed / elapsed, 2),
         "mean_batch_occupancy": (
             round(occupancy, 4) if occupancy is not None else None

@@ -27,6 +27,14 @@ device-sized groups. Three properties the offline stream never needed:
 Time comes from an injectable `clock` (default time.monotonic) so deadline
 logic is testable with a fake clock and zero real sleeps; `kick()` wakes
 the batcher to re-read the clock after a test advances it.
+
+TRACING (coconut_tpu/obs, COCONUT_TRACE=1): admission is where a
+request's trace is BORN — `submit()` starts the per-request root span
+("request") plus its "queue_wait" child, and stamps the trace_id onto the
+returned ServeFuture so a client can join its verdict (or a dead-letter
+line) back to the trace. Rejected submissions allocate nothing: no
+admission, no trace. With tracing disabled every hook is the shared no-op
+span — zero allocations on the admission path.
 """
 
 import threading
@@ -35,6 +43,7 @@ from collections import deque
 
 from .. import metrics
 from ..errors import ServiceClosedError, ServiceOverloadedError
+from ..obs import trace as otrace
 
 #: priority lanes, pop order: interactive requests coalesce ahead of bulk
 LANES = ("interactive", "bulk")
@@ -55,6 +64,10 @@ class ServeFuture:
         self._done = threading.Event()
         self._result = None
         self._exc = None
+        #: trace id of the request this future resolves (None with
+        #: tracing disabled) — the join key against trace exports,
+        #: flight records, and dead-letter lines
+        self.trace_id = None
 
     def done(self):
         return self._done.is_set()
@@ -87,7 +100,16 @@ class Request:
     """One queued credential-verify request: the credential, its message
     vector, the lane, the coalescing deadline, and the client's future."""
 
-    __slots__ = ("sig", "messages", "lane", "max_wait_ms", "t_submit", "future")
+    __slots__ = (
+        "sig",
+        "messages",
+        "lane",
+        "max_wait_ms",
+        "t_submit",
+        "future",
+        "span",
+        "queue_span",
+    )
 
     def __init__(self, sig, messages, lane, max_wait_ms, t_submit):
         if lane not in LANES:
@@ -98,6 +120,11 @@ class Request:
         self.max_wait_ms = max_wait_ms
         self.t_submit = t_submit
         self.future = ServeFuture()
+        # root span + queue-wait child start at ADMISSION (submit sets
+        # them after the request clears admission control); both are the
+        # shared no-op span while tracing is disabled
+        self.span = otrace.NOOP
+        self.queue_span = otrace.NOOP
 
     @property
     def deadline(self):
@@ -138,6 +165,11 @@ class RequestQueue:
             if depth >= self.max_depth:
                 metrics.count("serve_rejected")
                 raise ServiceOverloadedError(depth, self.max_depth)
+            req.span = otrace.start_span(
+                "request", root=True, lane=lane, max_wait_ms=max_wait_ms
+            )
+            req.queue_span = otrace.start_span("queue_wait", parent=req.span)
+            req.future.trace_id = req.span.trace_id
             self._lanes[lane].append(req)
             metrics.count("serve_admitted")
             self.cond.notify_all()

@@ -28,6 +28,17 @@ the cache-hot shape -> dispatch under retry/fallback -> demux -> future
 resolves. Per-request latency lands in the "serve_latency_s" histogram
 (`metrics.snapshot()["histograms"]`), the SLO readout.
 
+Tracing (coconut_tpu/obs, COCONUT_TRACE=1): each coalesced batch is a
+trace of its own — root "batch" span with "coalesce" (pad/assemble),
+"dispatch" (host encode + device dispatch), "device" (blocking readback)
+and "demux" children; retry attempts, fallback switches, and bisection
+splits land as events on the active span (retry.py / stream.py record
+them). The batch span links its member requests' trace_ids (and each
+request span carries `batch_trace` back), so a request's tree joins to
+the batch work done on its behalf; culprits isolated by bisection get a
+"dead_letter" event on THEIR request span and their trace_id in the
+dead-letter JSONL line.
+
 Lifecycle: `start()` launches the supervisor; `drain()` closes intake,
 flushes and settles everything in flight, and joins the thread — every
 accepted future is resolved. `shutdown(drain=False)` instead fails still-
@@ -42,6 +53,7 @@ import time
 
 from .. import metrics
 from ..errors import ServiceClosedError
+from ..obs import trace as otrace
 from ..retry import RetryPolicy, call_with_retry, note_attempt
 from ..stream import _dispatchers, _fallback_dispatcher, _make_bisector
 from .batcher import Batcher, demux, fail_all, pad_batch
@@ -216,28 +228,56 @@ class CredentialService:
         then the fallback."""
         seq = self._batch_seq
         self._batch_seq += 1
-        if self.pad_partial:
-            sigs, messages_list, _ = pad_batch(requests, self.max_batch)
-        else:
-            sigs = [r.sig for r in requests]
-            messages_list = [r.messages for r in requests]
-        metrics.observe(
-            "serve_batch_wait_s",
-            self.clock() - min(r.t_submit for r in requests),
+        bspan = otrace.start_span(
+            "batch",
+            root=True,
+            seq=seq,
+            n=len(requests),
+            members=[r.future.trace_id for r in requests]
+            if otrace.enabled()
+            else None,
         )
-        attempts = []
-        box = [None]
-        permanent = None
-        try:
-            box[0] = self._dispatch(sigs, messages_list, self.vk, self.params)
-        except self._policy.retryable as e:
-            note_attempt(attempts, e)
-        except Exception as e:
-            # permanent dispatch failure (bad inputs, code bug in a sync
-            # backend's compute): unlike the offline stream — where it
-            # aborts the run — the service contains it to THIS batch's
-            # futures; finalize re-raises without burning retries
-            permanent = e
+        for r in requests:
+            # the request->batch join: a request's trace knows which
+            # batch trace did its device work (flight dumps follow it)
+            r.span.set(batch_trace=bspan.trace_id, batch_seq=seq)
+        with otrace.use(bspan):
+            with otrace.span("coalesce"):
+                if self.pad_partial:
+                    sigs, messages_list, n_pad = pad_batch(
+                        requests, self.max_batch
+                    )
+                    bspan.set(n_pad=n_pad)
+                else:
+                    sigs = [r.sig for r in requests]
+                    messages_list = [r.messages for r in requests]
+            metrics.observe(
+                "serve_batch_wait_s",
+                self.clock() - min(r.t_submit for r in requests),
+            )
+            attempts = []
+            box = [None]
+            permanent = None
+            with otrace.span("dispatch", backend=type(self.backend).__name__):
+                try:
+                    box[0] = self._dispatch(
+                        sigs, messages_list, self.vk, self.params
+                    )
+                except self._policy.retryable as e:
+                    note_attempt(attempts, e)
+                    otrace.event(
+                        "attempt_failed",
+                        attempt=len(attempts),
+                        error=type(e).__name__,
+                    )
+                except Exception as e:
+                    # permanent dispatch failure (bad inputs, code bug in
+                    # a sync backend's compute): unlike the offline
+                    # stream — where it aborts the run — the service
+                    # contains it to THIS batch's futures; finalize
+                    # re-raises without burning retries
+                    permanent = e
+                    otrace.event("permanent_failure", error=type(e).__name__)
 
         def cycle():
             fin, box[0] = box[0], None
@@ -268,35 +308,58 @@ class CredentialService:
                 fallback=fallback,
             )
 
-        return (seq, requests, sigs, messages_list, finalize, attempts)
+        return (seq, requests, sigs, messages_list, finalize, attempts, bspan)
 
-    def _settle(self, seq, requests, sigs, messages_list, finalize, attempts):
+    def _settle(
+        self, seq, requests, sigs, messages_list, finalize, attempts, bspan
+    ):
         """Block on the batch result and resolve every request's future."""
-        try:
-            result = finalize()
-        except Exception as e:
-            # batch-level failure past retry+fallback: each cohabiting
-            # future gets the exception — never a silent hang
-            fail_all(requests, e)
-            return
-        if self.mode == "per_credential":
-            demux(requests, result[: len(requests)], clock=self.clock)
-            return
-        if result:
-            demux(requests, [True] * len(requests), clock=self.clock)
-            return
-        # grouped rejection: recover per-request verdicts by bisection so
-        # one forged credential fails only its own future
-        culprits = (
-            set(self._bisector(sigs, messages_list, seq, attempts))
-            if self._bisector is not None
-            else set(range(len(requests)))
-        )
-        demux(
-            requests,
-            [i not in culprits for i in range(len(requests))],
-            clock=self.clock,
-        )
+        with otrace.use(bspan):
+            try:
+                with otrace.span("device"):
+                    result = finalize()
+            except Exception as e:
+                # batch-level failure past retry+fallback: each
+                # cohabiting future gets the exception — never a silent
+                # hang
+                fail_all(requests, e)
+                bspan.end(error=type(e).__name__)
+                return
+            if self.mode == "per_credential":
+                demux(requests, result[: len(requests)], clock=self.clock)
+                bspan.end(result="demuxed")
+                return
+            if result:
+                demux(requests, [True] * len(requests), clock=self.clock)
+                bspan.end(result="accepted")
+                return
+            # grouped rejection: recover per-request verdicts by
+            # bisection so one forged credential fails only its own
+            # future; culprit dead-letter lines carry the CULPRIT
+            # request's trace_id (not the batch's), so an operator greps
+            # straight from a JSONL line to the request's span tree
+            culprits = (
+                set(
+                    self._bisector(
+                        sigs,
+                        messages_list,
+                        seq,
+                        attempts,
+                        trace_ids=[r.future.trace_id for r in requests],
+                    )
+                )
+                if self._bisector is not None
+                else set(range(len(requests)))
+            )
+            for i in culprits:
+                if i < len(requests):
+                    requests[i].span.event("dead_letter", batch_seq=seq)
+            demux(
+                requests,
+                [i not in culprits for i in range(len(requests))],
+                clock=self.clock,
+            )
+            bspan.end(result="bisected", n_culprits=len(culprits))
 
     def _run(self):
         pending = None
@@ -327,6 +390,7 @@ class CredentialService:
             self._crashed = e
             if pending is not None:
                 fail_all(pending[1], e)
+                otrace.end_span(pending[6], error=type(e).__name__)
             self._queue.close()
             fail_all(self._queue.drain_pending(), e)
             raise

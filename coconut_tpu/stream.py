@@ -65,6 +65,7 @@ from .errors import (
     CheckpointMismatchError,
     TransientBackendError,
 )
+from .obs import trace as otrace
 
 STATE_SCHEMA_VERSION = 2
 
@@ -148,9 +149,22 @@ class StreamState:
         if path and os.path.exists(path):
             try:
                 payload = self._load_checked(path)
-            except CheckpointCorruptError:
+            except CheckpointCorruptError as e:
                 self.quarantined = _quarantine(path)
                 metrics.count("checkpoint_quarantined")
+                # flight-record the quarantine next to the state file:
+                # the recent-span tail shows what the stream was doing
+                # when it last wrote (no-op with tracing disabled)
+                from .obs import flight as _flight
+
+                _flight.record(
+                    path,
+                    "checkpoint_quarantine",
+                    extra={
+                        "quarantined_to": self.quarantined,
+                        "detail": str(e),
+                    },
+                )
                 return
             stored = payload.get("fingerprint")
             if (
@@ -376,33 +390,45 @@ def _make_bisector(
             lambda: primary(s, m), policy, key=key, fallback=fallback
         )
 
-    def bisect(sigs, msgs, batch_index, attempts):
+    def bisect(sigs, msgs, batch_index, attempts, trace_ids=None):
+        """trace_ids: optional per-credential trace ids (the serve path's
+        request traces) so each dead-letter line carries ITS request's
+        trace_id; None (the offline stream) falls back to the active
+        bisection span's trace."""
         culprits = []
 
-        def rec(lo, hi, known_bad):
-            if not known_bad and check(
-                sigs[lo:hi], msgs[lo:hi], batch_index
-            ):
-                return
-            if hi - lo == 1:
-                culprits.append(lo)
-                return
-            metrics.count("bisections")
-            mid = (lo + hi) // 2
-            rec(lo, mid, False)
-            rec(mid, hi, False)
+        with otrace.span("bisect", batch=batch_index, n=len(sigs)) as bspan:
 
-        rec(0, len(sigs), True)
-        if log is not None:
-            for c in culprits:
-                log.append(
-                    batch=batch_index,
-                    credential=c,
-                    reason="grouped batch rejected; culprit isolated by "
-                    "bisection",
-                    attempts=attempts,
-                )
-                metrics.count("dead_letters")
+            def rec(lo, hi, known_bad):
+                if not known_bad and check(
+                    sigs[lo:hi], msgs[lo:hi], batch_index
+                ):
+                    return
+                if hi - lo == 1:
+                    culprits.append(lo)
+                    return
+                metrics.count("bisections")
+                mid = (lo + hi) // 2
+                bspan.event("split", lo=lo, hi=hi)
+                rec(lo, mid, False)
+                rec(mid, hi, False)
+
+            rec(0, len(sigs), True)
+            if log is not None:
+                for c in culprits:
+                    log.append(
+                        batch=batch_index,
+                        credential=c,
+                        reason="grouped batch rejected; culprit isolated by "
+                        "bisection",
+                        attempts=attempts,
+                        trace_id=(
+                            trace_ids[c]
+                            if trace_ids is not None and c < len(trace_ids)
+                            else None
+                        ),
+                    )
+                    metrics.count("dead_letters")
         return culprits
 
     return bisect
@@ -595,14 +621,27 @@ def verify_stream(
 
     def launch(i, sigs, msgs):
         """Dispatch batch i now (pipelining) and return (finalize,
-        attempts). finalize() re-runs the whole dispatch+readback cycle
-        under the retry ladder, then the fallback, before giving up."""
+        attempts, span). finalize() re-runs the whole dispatch+readback
+        cycle under the retry ladder, then the fallback, before giving
+        up. The batch's "stream_batch" trace starts here (possibly on the
+        prefetch worker thread) and is handed to settle() with the rest
+        of the launch state."""
         attempts = []
         box = [None]
-        try:
-            box[0] = dispatch(sigs, msgs, vk, params)
-        except policy.retryable as e:
-            note_attempt(attempts, e)
+        bspan = otrace.start_span(
+            "stream_batch", root=True, batch=i, n=len(sigs)
+        )
+        with otrace.use(bspan):
+            with otrace.span("dispatch", backend=type(backend).__name__):
+                try:
+                    box[0] = dispatch(sigs, msgs, vk, params)
+                except policy.retryable as e:
+                    note_attempt(attempts, e)
+                    otrace.event(
+                        "attempt_failed",
+                        attempt=len(attempts),
+                        error=type(e).__name__,
+                    )
 
         def cycle():
             fin, box[0] = box[0], None
@@ -621,30 +660,48 @@ def verify_stream(
                 cycle, policy, key=i, attempts=attempts, fallback=fallback
             )
 
-        return finalize, attempts
+        return finalize, attempts, bspan
 
-    def settle(idx, finalize, n, sigs, msgs, attempts):
-        result = finalize()
-        if bisector is not None and not result:
-            culprits = bisector(sigs, msgs, idx, attempts)
-            state.batches_failed += 1
-            state.failed += len(culprits)
-            state.verified += n - len(culprits)
-        else:
-            record(state, result, n)
-        # deliver results BEFORE persisting the checkpoint: a crash inside
-        # on_batch then re-runs the batch (at-least-once delivery) instead
-        # of silently dropping its verdicts
-        if on_batch is not None:
-            on_batch(idx, result)
-        state.next_batch = idx + 1
-        state.save()
+    def settle(idx, finalize, n, sigs, msgs, attempts, bspan):
+        with otrace.use(bspan):
+            try:
+                with otrace.span("device"):
+                    result = finalize()
+            except BaseException as e:
+                bspan.end(error=type(e).__name__)
+                raise
+            if bisector is not None and not result:
+                culprits = bisector(sigs, msgs, idx, attempts)
+                state.batches_failed += 1
+                state.failed += len(culprits)
+                state.verified += n - len(culprits)
+            else:
+                record(state, result, n)
+            # deliver results BEFORE persisting the checkpoint: a crash
+            # inside on_batch then re-runs the batch (at-least-once
+            # delivery) instead of silently dropping its verdicts
+            if on_batch is not None:
+                on_batch(idx, result)
+            state.next_batch = idx + 1
+            state.save()
+            bspan.event("checkpoint", next_batch=idx + 1)
+        bspan.end(
+            ok=bool(result) if not isinstance(result, list) else None
+        )
 
     def _launched():
         for i in range(state.next_batch, n_batches):
             sigs, messages_list = source(i)
-            finalize, attempts = launch(i, sigs, messages_list)
-            yield (i, finalize, len(sigs), sigs, messages_list, attempts)
+            finalize, attempts, bspan = launch(i, sigs, messages_list)
+            yield (
+                i,
+                finalize,
+                len(sigs),
+                sigs,
+                messages_list,
+                attempts,
+                bspan,
+            )
 
     launched = (
         _prefetch_launches(_launched, prefetch_depth)

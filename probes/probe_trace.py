@@ -1,0 +1,138 @@
+"""Chrome-trace/Perfetto export validator (the obs lane's smoke check).
+
+    python probes/probe_trace.py <trace.json>    # validate an export
+    python probes/probe_trace.py                 # self-test: generate one
+
+Checks the properties tooling relies on, not just JSON well-formedness:
+
+  - the document is valid JSON with a non-empty `traceEvents` list and
+    every event carries name/ph/ts/pid/tid (complete "X" events also a
+    non-negative dur) — the Perfetto loader's minimum;
+  - `ts` is monotonically non-decreasing across the event stream (the
+    exporter sorts; an unsorted stream renders but scrambles Perfetto's
+    flow rails);
+  - the span tree reconstructed from args.span_id/parent_id is
+    consistent: every child starts and ends inside its parent's
+    interval, and each span's dur >= the sum of its children's durs
+    (children are sequential stages of their parent — if this fails the
+    instrumentation double-counted a stage or leaked a clock).
+
+Used by ci.sh's obs lane on a trace generated from a real (CPU, stub
+backend) serve run with an injected fault, and imported by
+tests/test_obs.py to validate in-test exports.
+"""
+
+import json
+import sys
+
+#: float-microsecond rounding slack when comparing interval arithmetic
+EPS_US = 0.5
+
+
+def validate(path):
+    """Validate one Chrome-trace JSON file; returns a stats dict, raises
+    AssertionError (with a pointed message) on the first violation."""
+    with open(path) as f:
+        doc = json.load(f)
+    assert isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list), (
+        "not a Chrome trace document (want {'traceEvents': [...]})"
+    )
+    events = doc["traceEvents"]
+    assert events, "traceEvents is empty"
+
+    last_ts = None
+    spans = {}  # span_id -> (name, ts, dur, parent_id)
+    for i, ev in enumerate(events):
+        for key in ("name", "ph", "ts", "pid", "tid"):
+            assert key in ev, "event %d missing %r: %r" % (i, key, ev)
+        assert ev["ts"] >= 0, "event %d has negative ts: %r" % (i, ev)
+        if last_ts is not None:
+            assert ev["ts"] >= last_ts, (
+                "ts not monotonic at event %d: %r < %r"
+                % (i, ev["ts"], last_ts)
+            )
+        last_ts = ev["ts"]
+        if ev["ph"] == "X":
+            assert ev.get("dur", -1) >= 0, (
+                "X event %d has no/negative dur: %r" % (i, ev)
+            )
+            args = ev.get("args", {})
+            sid = args.get("span_id")
+            if sid is not None:
+                spans[sid] = (
+                    ev["name"],
+                    ev["ts"],
+                    ev["dur"],
+                    args.get("parent_id"),
+                )
+
+    children = {}
+    for sid, (name, ts, dur, parent) in spans.items():
+        if parent is not None and parent in spans:
+            children.setdefault(parent, []).append(sid)
+            pname, pts, pdur, _ = spans[parent]
+            assert ts >= pts - EPS_US and ts + dur <= pts + pdur + EPS_US, (
+                "child span %r [%s, +%s] escapes parent %r [%s, +%s]"
+                % (name, ts, dur, pname, pts, pdur)
+            )
+    for parent, kids in children.items():
+        pname, _, pdur, _ = spans[parent]
+        kid_total = sum(spans[k][2] for k in kids)
+        assert pdur + EPS_US * len(kids) >= kid_total, (
+            "span %r dur %s < sum of %d children %s (double-counted stage?)"
+            % (pname, pdur, len(kids), kid_total)
+        )
+    return {
+        "events": len(events),
+        "spans": len(spans),
+        "traces": len(
+            {ev.get("args", {}).get("trace_id") for ev in events} - {None}
+        ),
+        "nested": sum(len(k) for k in children.values()),
+    }
+
+
+def _selftest():
+    """Generate a small nested trace with a fake clock and validate it."""
+    import os
+    import tempfile
+
+    sys.path.insert(
+        0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+    )
+    from coconut_tpu.obs import export, trace
+
+    t = [0.0]
+
+    def clock():
+        t[0] += 0.001
+        return t[0]
+
+    tracer = trace.Tracer(clock=clock, ring=64)
+    root = tracer.start("request")
+    child = tracer.start("queue_wait", parent=root)
+    child.event("retry", attempt=1)
+    child.end()
+    tracer.start("dispatch", parent=root).end()
+    root.end()
+    path = os.path.join(tempfile.mkdtemp(), "trace.json")
+    export.write_chrome(tracer.tail(), path)
+    return validate(path)
+
+
+def main(argv):
+    if len(argv) > 1:
+        stats = validate(argv[1])
+        src = argv[1]
+    else:
+        stats = _selftest()
+        src = "selftest"
+    print(
+        "probe_trace: ok (%s: %d events, %d spans, %d traces, %d nested)"
+        % (src, stats["events"], stats["spans"], stats["traces"], stats["nested"])
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

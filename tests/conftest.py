@@ -61,3 +61,9 @@ def pytest_configure(config):
         "coalescing, admission control, demux/drain invariants, loadgen), "
         "also run explicitly by ci.sh's serve lane",
     )
+    config.addinivalue_line(
+        "markers",
+        "obs: observability suite (request-scoped tracing, Chrome-trace/"
+        "Perfetto export, flight recorder, percentile edge cases), also "
+        "run explicitly by ci.sh's obs lane",
+    )
