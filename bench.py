@@ -37,7 +37,13 @@ counts in the same JSON line under "serve". Knobs: BENCH_SERVE_SECONDS
 (default 2*max_batch), BENCH_SERVE_MODE (per_credential|grouped),
 BENCH_SERVE_FORGED (default 1 — forged credentials in the pool),
 BENCH_OFFLINE=0 skips the offline lanes so `--serve` can run standalone
-(the CPU smoke in ci.sh does exactly that).
+(the CPU smoke in ci.sh does exactly that). BENCH_SERVE_DEVICES="1,2,4,8"
+additionally runs the dispatcher-pool device-count sweep — per pool size:
+goodput, p99 latency, occupancy, per-device dispatch counts, and scaling
+efficiency goodput_n/(n*goodput_1) — embedded under "serve"."scaling"
+(BENCH_SERVE_SWEEP_SECONDS trims the per-point duration; on the jax
+backend each executor pins to a real device, elsewhere executors are
+unpinned workers).
 """
 
 import json
@@ -179,7 +185,95 @@ def bench_serve(ge, params, vk, sigs, msgs_list, extras, backend_name):
         **report,
         "trace_overhead": trace_overhead,
     }
+    if os.environ.get("BENCH_SERVE_DEVICES"):
+        extras["serve"]["scaling"] = _bench_serve_scaling(
+            params, vk, pool, backend_name, mode, max_batch, max_wait_ms
+        )
     return report["goodput_per_s"]
+
+
+def _bench_serve_scaling(params, vk, pool, backend_name, mode, max_batch,
+                         max_wait_ms):
+    """BENCH_SERVE_DEVICES="1,2,4,8" device-count sweep (ISSUE 8 headline):
+    one saturating closed-loop loadgen pass per dispatcher-pool size,
+    reporting goodput, p99 latency, batch occupancy, per-device dispatch
+    counts, and scaling efficiency (goodput_n / (n * goodput_1)). On the
+    jax backend each executor pins to a real jax device (so 8 means the
+    8-device mesh's chips); other backends get n unpinned worker
+    executors. Each point drives 2*max_batch clients PER device so every
+    pool size runs at ITS saturation, not the smallest pool's."""
+    from coconut_tpu.serve import CredentialService, run_loadgen
+
+    counts = [
+        int(tok)
+        for tok in os.environ["BENCH_SERVE_DEVICES"].replace(",", " ").split()
+    ]
+    seconds = float(
+        os.environ.get(
+            "BENCH_SERVE_SWEEP_SECONDS",
+            os.environ.get("BENCH_SERVE_SECONDS", "2"),
+        )
+    )
+    points = []
+    base_goodput = None
+    for n in counts:
+        devices = n
+        if backend_name == "jax":
+            import jax
+
+            devs = jax.devices()
+            if len(devs) >= n:
+                devices = list(devs[:n])
+        svc = CredentialService(
+            backend_name,
+            vk,
+            params,
+            mode=mode,
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            max_depth=max(1024, 4 * max_batch * n),
+            devices=devices,
+        )
+        with svc:
+            warm = [
+                svc.submit(*pool[i % len(pool)][:2])
+                for i in range(max_batch * n)
+            ]
+            for f in warm:
+                f.result(timeout=600.0)
+            report = run_loadgen(
+                svc,
+                pool,
+                duration_s=seconds,
+                arrival="closed",
+                concurrency=2 * max_batch * n,
+            )
+        assert report["dropped_futures"] == 0, (
+            "serve scaling sweep (devices=%d) dropped futures: %r"
+            % (n, report)
+        )
+        goodput = report["goodput_per_s"]
+        if base_goodput is None:
+            base_goodput = goodput
+        devices_seen = report["devices"] or {}
+        points.append({
+            "devices": n,
+            "goodput_per_s": goodput,
+            "dropped_futures": report["dropped_futures"],
+            "p99_latency_s": report["latency_s"]["p99"],
+            "mean_batch_occupancy": report["mean_batch_occupancy"],
+            "devices_with_dispatches": len(devices_seen),
+            "per_device_dispatches": {
+                label: d.get("dispatches", 0)
+                for label, d in sorted(devices_seen.items())
+            },
+            "scaling_efficiency": (
+                round(goodput / (n * base_goodput), 4)
+                if base_goodput
+                else None
+            ),
+        })
+    return {"seconds_per_point": seconds, "points": points}
 
 
 def main():

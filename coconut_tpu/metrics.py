@@ -32,6 +32,17 @@ occupancy is batched_requests / (batches * max_batch)), "serve_valid" /
 "serve_invalid" / "serve_failed_requests" / "serve_cancelled" (outcomes),
 and the "serve_latency_s" / "serve_batch_wait_s" histograms.
 
+The mesh-scale dispatcher pool adds PER-DEVICE and placement surfaces:
+each device executor `<d>` counts "serve_dev<d>_dispatches" /
+"serve_dev<d>_requests" and accumulates the "serve_dev<d>_busy_s" timer
+(occupancy over a window is its delta / wall), the adaptive placement
+policy counts "serve_placed_single" / "serve_placed_sharded", and
+point-in-time GAUGES ("serve_queue_depth", "serve_dev<d>_load" —
+`set_gauge`, last-write-wins, reported verbatim under "gauges") expose
+the routing state the least-loaded picker saw. `counters_with_prefix` /
+`timers_with_prefix` read a whole label family (e.g. "serve_dev")
+without enumerating device ids.
+
 THREAD SAFETY: the serving layer is the first multi-threaded writer
 (admission happens on client threads while the supervisor thread settles
 batches), so every mutation and `snapshot()` runs under one module lock —
@@ -68,6 +79,7 @@ _lock = threading.RLock()
 _timers = defaultdict(float)
 _counts = defaultdict(int)
 _hists = {}
+_gauges = {}
 _providers = {}  # snapshot section name -> zero-arg callable
 
 # per-histogram retained-sample window (memory bound; count/total/max stay
@@ -98,6 +110,34 @@ def get_count(name):
     """Current value of counter `name` (0 if never counted)."""
     with _lock:
         return _counts.get(name, 0)
+
+
+def counters_with_prefix(prefix):
+    """{name: value} for every counter whose name starts with `prefix` —
+    how the serving report reads a whole per-device family
+    ("serve_dev<d>_dispatches") without enumerating device ids."""
+    with _lock:
+        return {k: v for k, v in _counts.items() if k.startswith(prefix)}
+
+
+def timers_with_prefix(prefix):
+    """{name: seconds} for every timer whose name starts with `prefix`
+    (the per-device busy-time family)."""
+    with _lock:
+        return {k: v for k, v in _timers.items() if k.startswith(prefix)}
+
+
+def set_gauge(name, value):
+    """Set the point-in-time gauge `name` (e.g. "serve_queue_depth", a
+    device executor's current load): last-write-wins, reported verbatim
+    by snapshot() under "gauges" — unlike counters these go DOWN."""
+    with _lock:
+        _gauges[name] = value
+
+
+def get_gauge(name, default=None):
+    with _lock:
+        return _gauges.get(name, default)
 
 
 def observe(name, seconds):
@@ -197,6 +237,8 @@ def snapshot():
             snap["histograms"] = {
                 k: _hist_readout(h) for k, h in sorted(_hists.items())
             }
+        if _gauges:
+            snap["gauges"] = dict(sorted(_gauges.items()))
         providers = list(_providers.items())
     # provider callables run OUTSIDE the lock (they may take their own)
     for name, fn in providers:
@@ -211,6 +253,7 @@ def reset():
         _timers.clear()
         _counts.clear()
         _hists.clear()
+        _gauges.clear()
 
 
 def rate(counter, timer_name):

@@ -45,6 +45,12 @@ Span taxonomy (README "Observability" for the glossary):
   fallback (retry.py ladder), split (each bisection halving),
   dead_letter, pad_lanes, checkpoint.
 
+  Against the serve dispatcher pool, "batch" and "dispatch"/"device"
+  spans carry `device` (the executor label: "0".."N-1" or "mesh") and
+  the batch root carries `placement` ("single" | "sharded") — so a
+  dead-lettered request's span tree names the device that rejected it
+  and which side of the adaptive routing policy its batch took.
+
 `metrics.snapshot()` gains a "trace_stages" section while tracing is
 enabled (per-span-name count/total/mean — the queue-wait vs coalesce vs
 encode vs device vs demux breakdown), via metrics' provider hook so the

@@ -12,10 +12,14 @@ servers solve, applied to credential verification:
               oldest request's max_wait_ms deadline; identity-lane pad
               partial batches so jit shapes stay cache-hot; demux
               verdict bits back onto the originating futures
-  service.py  the supervisor thread: dispatch under the PR-2
-              retry/fallback/bisection ladder (one forged credential
-              fails ITS future and is dead-lettered, cohabitants pass),
-              PR-3 async double-buffering, start/drain/shutdown
+  service.py  the placer thread + per-device executor pool: adaptive
+              placement (least-loaded single device, or dp-sharded
+              across the mesh for large bulk batches), each executor
+              running PR-3 async double-buffering, every batch under
+              the PR-2 retry/fallback/bisection ladder (one forged
+              credential fails ITS future and is dead-lettered,
+              cohabitants pass — per batch, hence per device),
+              start/drain/shutdown
   loadgen.py  closed- and open-loop (Poisson) load generation with
               p50/p95/p99 latency, goodput, occupancy, rejection report
 

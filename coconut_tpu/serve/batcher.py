@@ -88,21 +88,30 @@ class Batcher:
             return False, min(left, _POLL_CAP_S)
         return False, _POLL_CAP_S
 
-    def next_batch(self, block=True):
+    def next_batch(self, block=True, ready=None):
+        """ready: optional zero-arg predicate consulted before any flush —
+        the dispatcher pool's backpressure seam. While it returns False
+        the batcher HOLDS the backlog in the queue (where admission
+        control can see and bound it) instead of popping work no device
+        executor can accept yet; whoever frees capacity must kick() the
+        queue so the wait here re-checks."""
         q = self.queue
         with q.cond:
             while True:
-                flush, wait_s = self._ready_locked()
-                if flush:
-                    batch = q._pop_locked(self.max_batch)
-                    metrics.count("serve_batches")
-                    metrics.count("serve_batched_requests", len(batch))
-                    for req in batch:
-                        # queue_wait ends the moment the request is IN a
-                        # coalesced batch — its dur is the admission->
-                        # flush latency the per-stage breakdown reports
-                        req.queue_span.end(coalesced_with=len(batch))
-                    return batch
+                if ready is not None and not ready():
+                    wait_s = _POLL_CAP_S
+                else:
+                    flush, wait_s = self._ready_locked()
+                    if flush:
+                        batch = q._pop_locked(self.max_batch)
+                        metrics.count("serve_batches")
+                        metrics.count("serve_batched_requests", len(batch))
+                        for req in batch:
+                            # queue_wait ends the moment the request is IN
+                            # a coalesced batch — its dur is the admission->
+                            # flush latency the per-stage breakdown reports
+                            req.queue_span.end(coalesced_with=len(batch))
+                        return batch
                 if q.closed and q._depth_locked() == 0:
                     return None
                 if not block:

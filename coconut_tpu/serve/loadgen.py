@@ -28,7 +28,11 @@ tracing enabled (COCONUT_TRACE=1) it also embeds `stage_breakdown_s` —
 the per-stage span totals accumulated DURING the run (queue_wait /
 coalesce / dispatch / device / demux), which finally separates "slow
 device" from "slow batcher" for the same requests the latency
-percentiles describe; null when tracing is off.
+percentiles describe; null when tracing is off. Against a dispatcher
+POOL the report adds `devices` (per-executor dispatch/request/busy-second
+deltas with occupancy = busy_s / wall) and `placement` (single vs sharded
+routing decisions, plus capacity spills) — the per-device surfaces the
+scaling sweep (bench.py BENCH_SERVE_DEVICES) is built from.
 
 Determinism knobs: `rng` (arrival jitter + pool sampling), `clock`, and
 `sleep` are injectable, so tests can drive the generator without
@@ -74,6 +78,43 @@ def _stage_delta(before, after):
             "mean_s": round(dt / dc, 6),
         }
     return out
+
+
+def _device_report(before_counts, before_timers, elapsed):
+    """Per-device {dispatches, requests, busy_s, occupancy} delta over the
+    run, keyed by executor label — nonzero dispatches on EVERY device is
+    the pool's "actually scaled out" invariant (bench/ci assert it)."""
+    d_counts = metrics.counters_with_prefix("serve_dev")
+    d_timers = metrics.timers_with_prefix("serve_dev")
+    devices = {}
+    for name, value in d_counts.items():
+        label, _, field = name[len("serve_dev"):].rpartition("_")
+        if field not in ("dispatches", "requests"):
+            continue
+        delta = value - before_counts.get(name, 0)
+        if delta:
+            devices.setdefault(label, {})[field] = delta
+    for name, value in d_timers.items():
+        if not name.endswith("_busy_s"):
+            continue
+        label = name[len("serve_dev"):-len("_busy_s")]
+        busy = value - before_timers.get(name, 0.0)
+        if label in devices or busy > 0:
+            dev = devices.setdefault(label, {})
+            dev["busy_s"] = round(busy, 6)
+            dev["occupancy"] = round(min(busy / elapsed, 1.0), 4)
+    return devices or None
+
+
+def _placement_report(before_counts):
+    """{single, sharded[, spill]} placement-decision deltas over the run."""
+    out = {}
+    for kind in ("single", "sharded", "spill"):
+        name = "serve_placed_%s" % kind
+        delta = metrics.get_count(name) - before_counts.get(name, 0)
+        if delta or kind != "spill":
+            out[kind] = delta
+    return out if (out.get("single") or out.get("sharded")) else None
 
 
 def _percentiles(latencies):
@@ -153,6 +194,9 @@ def run_loadgen(
     tally = _Tally()
     occ0_reqs = metrics.get_count("serve_batched_requests")
     occ0_batches = metrics.get_count("serve_batches")
+    dev0_counts = metrics.counters_with_prefix("serve_dev")
+    dev0_timers = metrics.timers_with_prefix("serve_dev")
+    placed0 = metrics.counters_with_prefix("serve_placed")
     stages0 = _stage_totals()
     t0 = clock()
     t_end = t0 + duration_s
@@ -222,6 +266,8 @@ def run_loadgen(
         "verdict_mismatches": tally.mismatches,
         "latency_s": _percentiles(tally.latencies),
         "stage_breakdown_s": _stage_delta(stages0, _stage_totals()),
+        "devices": _device_report(dev0_counts, dev0_timers, elapsed),
+        "placement": _placement_report(placed0),
         "goodput_per_s": round(tally.completed / elapsed, 2),
         "mean_batch_occupancy": (
             round(occupancy, 4) if occupancy is not None else None

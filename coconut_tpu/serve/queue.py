@@ -217,6 +217,13 @@ class RequestQueue:
         with self.cond:
             return self._depth_locked()
 
+    def depths(self):
+        """{lane: queued count} — the per-lane backlog readout behind the
+        "serve_queue_depth" gauge and the placement policy's view of how
+        latency-sensitive the current backlog is."""
+        with self.cond:
+            return {lane: len(d) for lane, d in self._lanes.items()}
+
     def drain_pending(self):
         """Pop EVERYTHING queued (the non-draining shutdown path: the
         caller fails these futures with ServiceClosedError)."""

@@ -1,55 +1,83 @@
-"""The online credential service: a supervisor loop wiring the deadline
-batcher into the existing offline machinery.
+"""The online credential service: a mesh-native dispatcher pool wiring the
+deadline batcher into the existing offline machinery.
 
-One background thread owns the device: it pops coalesced batches off the
-request queue (serve/batcher.py), dispatches them through the SAME seams
-the offline stream uses, and demuxes per-credential verdicts back onto the
-originating futures. Everything fault- and perf-related is reused, not
-reinvented:
+Topology (PR 6): a PLACER thread owns coalescing and placement; a pool of
+per-device EXECUTOR threads owns dispatch. The placer pops coalesced
+batches off the request queue (serve/batcher.py) and hands each to an
+executor; every executor runs the same launch/settle async double-buffer
+the single-supervisor service ran — so encode for batch i+1 overlaps
+device compute for batch i PER DEVICE — through the SAME seams the
+offline stream uses, and demuxes per-credential verdicts back onto the
+originating futures.
+
+Placement is adaptive, decided per coalesced batch:
+
+  - LEAST-LOADED SINGLE DEVICE (default): the batch goes whole to the
+    executor with the fewest unsettled request lanes — the latency path:
+    no cross-chip collective, one device round trip.
+  - SHARDED ACROSS THE MESH: a batch of at least `sharded_min_lanes`
+    containing no interactive requests routes through the dp-sharded
+    mesh program (tpu/shard.py, via stream._dispatchers(mesh=...)) — the
+    throughput path for bulk traffic, where one batch's work spans every
+    chip. Batch size and lane decide; interactive requests never pay a
+    collective on their latency path.
+
+  Both paths keep jit shapes cache-hot through the identity-lane padding
+  convention: per-credential batches pad to max_batch (pad_partial),
+  grouped mesh batches pad to one fixed power-of-two shape.
+
+Backpressure: each executor accepts at most one unsettled batch (two
+when its dispatch is async — the in-flight one plus the one being
+encoded), and the batcher's `ready` gate holds any further backlog IN
+the request queue, where bounded-depth admission control can see and
+refuse it. Without the gate, a pool would silently convert overload into
+unbounded executor inboxes.
+
+Everything fault- and perf-related is reused, not reinvented:
 
   - PR-2 supervision: each batch's dispatch+readback cycle runs under
     `retry.call_with_retry` (bounded backoff, deterministic jitter), then
     degrades to `fallback_backend`; in grouped mode a rejected batch is
-    bisected with `stream._make_bisector` — grouped probes over halved
-    slices, per-credential at the leaves — so ONE forged credential fails
-    ITS future (and lands in the dead-letter JSONL) while every cohabiting
-    request in the batch resolves valid.
+    bisected with `stream._make_bisector` — so ONE forged credential
+    fails ITS future (and lands in the dead-letter JSONL) while every
+    cohabiting request resolves valid. Containment is per batch, hence
+    per device: a fault on one device's batch never stalls the others'
+    pipelines.
   - PR-3 pipelining: dispatch goes through the backends' `*_async` seams
-    (probed by `stream._dispatchers`), so while the device runs batch i
-    the supervisor coalesces and host-encodes batch i+1 — the encode rides
-    the static-operand cache, so at steady state it is signature points +
-    scalar digits only. One batch stays in flight (double-buffering);
-    when no new batch is ready the in-flight one settles immediately, so
-    idle-tail latency never waits on future traffic.
+    (probed by `stream._dispatchers`, optionally pinned to one jax
+    device), the encode rides the static-operand cache.
 
 Request path: `submit()` -> admission control (bounded queue, typed
-rejection) -> coalesce (full batch or oldest deadline) -> identity-pad to
-the cache-hot shape -> dispatch under retry/fallback -> demux -> future
-resolves. Per-request latency lands in the "serve_latency_s" histogram
-(`metrics.snapshot()["histograms"]`), the SLO readout.
+rejection) -> coalesce (full batch or oldest deadline) -> place
+(least-loaded device, or mesh-sharded) -> identity-pad to the cache-hot
+shape -> dispatch under retry/fallback -> demux -> future resolves.
+Per-request latency lands in the "serve_latency_s" histogram; per-device
+dispatch/request counters, busy-second timers, placement counters, and
+queue-depth/load gauges land in `metrics.snapshot()` (see metrics.py).
 
 Tracing (coconut_tpu/obs, COCONUT_TRACE=1): each coalesced batch is a
-trace of its own — root "batch" span with "coalesce" (pad/assemble),
-"dispatch" (host encode + device dispatch), "device" (blocking readback)
-and "demux" children; retry attempts, fallback switches, and bisection
-splits land as events on the active span (retry.py / stream.py record
-them). The batch span links its member requests' trace_ids (and each
-request span carries `batch_trace` back), so a request's tree joins to
-the batch work done on its behalf; culprits isolated by bisection get a
-"dead_letter" event on THEIR request span and their trace_id in the
-dead-letter JSONL line.
+trace of its own — root "batch" span (stamped with the DEVICE id and the
+PLACEMENT decision) with "coalesce", "dispatch" (device-stamped),
+"device" and "demux" children; retry attempts, fallback switches, and
+bisection splits land as events on the active span. The batch span links
+its member requests' trace_ids (and each request span carries
+`batch_trace` back); culprits isolated by bisection get a "dead_letter"
+event on THEIR request span — so a dead-lettered request's span tree
+names the device that verified (and rejected) it.
 
-Lifecycle: `start()` launches the supervisor; `drain()` closes intake,
-flushes and settles everything in flight, and joins the thread — every
-accepted future is resolved. `shutdown(drain=False)` instead fails still-
-QUEUED requests with `ServiceClosedError` (in-flight work still settles).
-A supervisor crash sweeps all queued+in-flight futures with the crash
-exception — no caller ever hangs on a dropped future. The context-manager
-form (`with CredentialService(...) as svc:`) is start()/drain().
+Lifecycle: `start()` launches the executors and the placer; `drain()`
+closes intake, flushes and settles everything in flight, and joins all
+threads — every accepted future is resolved. `shutdown(drain=False)`
+instead fails still-QUEUED requests with `ServiceClosedError` (batches
+already placed on executors still settle). A placer or executor-loop
+crash sweeps all queued+in-flight futures with the crash exception — no
+caller ever hangs on a dropped future. The context-manager form
+(`with CredentialService(...) as svc:`) is start()/drain().
 """
 
 import threading
 import time
+from collections import deque
 
 from .. import metrics
 from ..errors import ServiceClosedError
@@ -58,6 +86,163 @@ from ..retry import RetryPolicy, call_with_retry, note_attempt
 from ..stream import _dispatchers, _fallback_dispatcher, _make_bisector
 from .batcher import Batcher, demux, fail_all, pad_batch
 from .queue import RequestQueue
+
+
+def _next_pow2(n):
+    """Smallest power of two >= n (and >= 2) — the grouped kernel's batch
+    shape convention (tpu/backend.py's Bp)."""
+    return 1 << max(1, (n - 1).bit_length())
+
+
+class _DeviceExecutor:
+    """One device's serving loop: an inbox worker thread running the
+    launch/settle async double-buffer for ITS device.
+
+    Load accounting (`load()`: unsettled request lanes) drives the
+    placer's least-loaded pick; `can_accept()` bounds unsettled batches
+    to 1 (sync dispatch) or 2 (async: one in flight + one being encoded),
+    which is the pool-shaped generalization of the old single supervisor's
+    double buffer — anything beyond that stays in the request queue where
+    admission control is. Settling kicks the request queue so a
+    capacity-gated placer re-checks."""
+
+    def __init__(
+        self,
+        service,
+        index,
+        label=None,
+        device=None,
+        dispatch=None,
+        is_async=False,
+        placement="single",
+    ):
+        self.service = service
+        self.index = index
+        self.label = str(index) if label is None else label
+        self.device = device
+        self.dispatch = dispatch
+        self.is_async = is_async
+        self.placement = placement  # "single" | "sharded"
+        self.busy_timer = "serve_dev%s_busy_s" % self.label
+        self._cond = threading.Condition()
+        self._inbox = deque()
+        self._load = 0  # unsettled request lanes (queued + in flight)
+        self._batches_out = 0  # unsettled batches (capacity bound)
+        self._closed = False
+        self._thread = None
+
+    # -- placer side ---------------------------------------------------------
+
+    def load(self):
+        with self._cond:
+            return self._load
+
+    def can_accept(self):
+        with self._cond:
+            return self._batches_out < (2 if self.is_async else 1)
+
+    def submit_batch(self, requests):
+        with self._cond:
+            self._inbox.append(requests)
+            self._load += len(requests)
+            self._batches_out += 1
+            load = self._load
+            self._cond.notify_all()
+        metrics.set_gauge("serve_dev%s_load" % self.label, load)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run,
+                name="coconut-serve-dev%s" % self.label,
+                daemon=True,
+            )
+            self._thread.start()
+
+    def close(self):
+        """Stop accepting; the loop still settles its inbox, then exits."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def join(self, timeout=None):
+        if self._thread is None:
+            return True
+        self._thread.join(timeout)
+        return not self._thread.is_alive()
+
+    def poison(self, exc):
+        """Crash sweep: refuse everything still queued on this device."""
+        with self._cond:
+            self._closed = True
+            swept = list(self._inbox)
+            self._inbox.clear()
+            self._load = 0
+            self._batches_out = 0
+            self._cond.notify_all()
+        for batch in swept:
+            fail_all(batch, exc)
+
+    # -- worker loop ---------------------------------------------------------
+
+    def _next(self, block):
+        with self._cond:
+            while True:
+                if self._inbox:
+                    return self._inbox.popleft()
+                if self._closed or not block:
+                    return None
+                self._cond.wait()
+
+    def _finish(self, n_lanes):
+        with self._cond:
+            self._load = max(0, self._load - n_lanes)
+            self._batches_out = max(0, self._batches_out - 1)
+            load = self._load
+        metrics.set_gauge("serve_dev%s_load" % self.label, load)
+        # capacity freed: wake a placer gated on ready()
+        self.service._queue.kick()
+
+    def _run(self):
+        svc = self.service
+        pending = None
+        try:
+            while True:
+                batch = self._next(block=pending is None)
+                if batch:
+                    launched = svc._launch(batch, self)
+                    if pending is not None:
+                        svc._settle(*pending)
+                        self._finish(len(pending[1]))
+                        pending = None
+                    if self.is_async:
+                        # double-buffer: leave this batch in flight and go
+                        # take the next while the device runs
+                        pending = launched
+                    else:
+                        svc._settle(*launched)
+                        self._finish(len(batch))
+                    continue
+                if pending is not None:
+                    # nothing ready to overlap with: settle the in-flight
+                    # batch now instead of holding its latency hostage
+                    svc._settle(*pending)
+                    self._finish(len(pending[1]))
+                    pending = None
+                    continue
+                # closed and inbox empty: exit
+                return
+        except BaseException as e:  # loop-level crash (a code bug, not a
+            # batch fault — those are contained in _launch/_settle):
+            # sweep this device's in-flight work, then take the whole
+            # service down so no future anywhere dangles
+            if pending is not None:
+                fail_all(pending[1], e)
+                otrace.end_span(pending[6], error=type(e).__name__)
+            svc._crash(e)
+            raise
 
 
 class CredentialService:
@@ -71,7 +256,14 @@ class CredentialService:
     max_depth: admission bound. pad_partial: identity-pad partial batches
     to max_batch (per_credential mode) so jit shapes stay cache-hot —
     grouped mode never pads, its encode pads internally to a power of two.
-    clock: injectable time source for deadline tests."""
+    clock: injectable time source for deadline tests.
+
+    Pool shape (PR 6): `devices` is None (one executor, the PR-4
+    behavior), an int N (N executors — worker-thread parallelism for
+    backends without device placement), or a list of jax devices (one
+    executor pinned to each). `mesh` adds the dp-sharded mesh dispatch
+    lane; batches of >= `sharded_min_lanes` (default max_batch) with no
+    interactive requests route through it (see _route)."""
 
     def __init__(
         self,
@@ -87,6 +279,9 @@ class CredentialService:
         dead_letter_path=None,
         pad_partial=True,
         clock=time.monotonic,
+        devices=None,
+        mesh=None,
+        sharded_min_lanes=None,
     ):
         from ..backend import get_backend
         from ..errors import TransientBackendError
@@ -105,7 +300,52 @@ class CredentialService:
         self.max_wait_ms = max_wait_ms
         self.pad_partial = pad_partial and mode == "per_credential"
         self.clock = clock
-        self._dispatch, _, self._is_async = _dispatchers(backend, mode)
+
+        if devices is None:
+            device_list = [None]
+        elif isinstance(devices, int):
+            if devices < 1:
+                raise ValueError("devices must be >= 1 (got %r)" % (devices,))
+            device_list = [None] * devices
+        else:
+            device_list = list(devices)
+            if not device_list:
+                raise ValueError("devices list must be non-empty")
+        self._executors = []
+        for i, dev in enumerate(device_list):
+            dispatch, _, is_async = _dispatchers(backend, mode, device=dev)
+            self._executors.append(
+                _DeviceExecutor(
+                    self, i, device=dev, dispatch=dispatch, is_async=is_async
+                )
+            )
+        self._is_async = self._executors[0].is_async
+
+        self.mesh = mesh
+        self.sharded_min_lanes = (
+            max_batch if sharded_min_lanes is None else sharded_min_lanes
+        )
+        self._mesh_executor = None
+        if mesh is not None:
+            pad_to = None
+            if mode == "grouped" and "dp" in mesh.shape:
+                # ONE fixed grouped shape across all occupancy levels:
+                # the sharded encode's own floor (2*ndp) or the service's
+                # max batch rounded to the kernel's power-of-two, whichever
+                # is larger — varying coalesced sizes never recompile
+                pad_to = max(2 * mesh.shape["dp"], _next_pow2(max_batch))
+            mesh_dispatch, _, _ = _dispatchers(
+                backend, mode, mesh=mesh, mesh_pad_to=pad_to
+            )
+            self._mesh_executor = _DeviceExecutor(
+                self,
+                len(self._executors),
+                label="mesh",
+                dispatch=mesh_dispatch,
+                is_async=True,
+                placement="sharded",
+            )
+
         self._fallback_dispatch = (
             _fallback_dispatcher(fallback_backend, mode)
             if fallback_backend is not None
@@ -139,6 +379,7 @@ class CredentialService:
         self._queue = RequestQueue(max_depth=max_depth, clock=clock)
         self._batcher = Batcher(self._queue, max_batch, clock=clock)
         self._thread = None
+        self._seq_lock = threading.Lock()
         self._batch_seq = 0  # dead-letter batch ids + retry jitter keys
         self._crashed = None
 
@@ -165,23 +406,40 @@ class CredentialService:
         return self._queue.depth()
 
     def kick(self):
-        """Wake the supervisor to re-read the clock (fake-clock tests)."""
+        """Wake the placer to re-read the clock (fake-clock tests)."""
         self._queue.kick()
 
     # -- lifecycle -----------------------------------------------------------
 
+    def _all_executors(self):
+        if self._mesh_executor is not None:
+            return self._executors + [self._mesh_executor]
+        return list(self._executors)
+
     def start(self):
         if self._thread is None:
+            for ex in self._all_executors():
+                ex.start()
             self._thread = threading.Thread(
                 target=self._run, name="coconut-serve", daemon=True
             )
             self._thread.start()
         return self
 
+    def _close_pool(self, timeout, ok):
+        """Join the placer's executors after intake+placement ended; every
+        inbox batch still settles before an executor exits."""
+        for ex in self._all_executors():
+            ex.close()
+        for ex in self._all_executors():
+            ok = ex.join(timeout) and ok
+        return ok
+
     def drain(self, timeout=None):
-        """Close intake, settle every accepted request, join the
-        supervisor. Every accepted future is resolved on return (True iff
-        the supervisor exited within `timeout`)."""
+        """Close intake, settle every accepted request, join the placer
+        and the executor pool. Every accepted future is resolved on return
+        (True iff all threads exited within `timeout`, applied per
+        join)."""
         self._queue.close()
         if self._thread is None:
             # never started: nothing will settle the queue — fail loudly
@@ -192,12 +450,12 @@ class CredentialService:
             )
             return True
         self._thread.join(timeout)
-        return not self._thread.is_alive()
+        return self._close_pool(timeout, not self._thread.is_alive())
 
     def shutdown(self, drain=True, timeout=None):
         """drain=True: alias for drain(). drain=False: refuse the queued
         backlog (futures fail with ServiceClosedError) but still settle
-        work already in flight, then join."""
+        work already placed on executors, then join."""
         if drain:
             return self.drain(timeout)
         self._queue.close()
@@ -208,7 +466,7 @@ class CredentialService:
         )
         if self._thread is not None:
             self._thread.join(timeout)
-            return not self._thread.is_alive()
+            return self._close_pool(timeout, not self._thread.is_alive())
         return True
 
     def __enter__(self):
@@ -218,30 +476,98 @@ class CredentialService:
         self.drain()
         return False
 
-    # -- supervisor ----------------------------------------------------------
+    # -- placement -----------------------------------------------------------
 
-    def _launch(self, requests):
-        """Assemble + dispatch one coalesced batch NOW; return the settle
-        closure state. Mirrors stream.verify_stream's launch(): the first
-        dispatch attempt is consumed eagerly (pipelining), finalize()
-        re-runs the full dispatch+readback cycle under the retry ladder,
-        then the fallback."""
-        seq = self._batch_seq
-        self._batch_seq += 1
+    def _route(self, requests):
+        """The adaptive placement policy: "sharded" (dp-sharded across the
+        mesh) or "single" (whole batch to one device). Batch size and lane
+        decide: only batches of at least `sharded_min_lanes` with NO
+        interactive requests take the mesh — a turnstile request never
+        pays a cross-chip collective on its latency path, while bulk
+        backfill batches get every chip."""
+        if self._mesh_executor is None:
+            return "single"
+        if len(requests) < self.sharded_min_lanes:
+            return "single"
+        if any(r.lane == "interactive" for r in requests):
+            return "single"
+        return "sharded"
+
+    def _has_capacity(self):
+        """ready() gate for the batcher: pop a batch only when SOME
+        executor can take it, otherwise the backlog stays in the bounded
+        queue and overload stays visible to admission control."""
+        return any(ex.can_accept() for ex in self._all_executors())
+
+    def _place(self, requests):
+        """Pick the executor for one coalesced batch: the policy's route,
+        with capacity spill (a full mesh lane falls back to the
+        least-loaded device and vice versa — adaptive, never blocking a
+        popped batch behind one hot executor)."""
+        route = self._route(requests)
+        metrics.count(
+            "serve_placed_sharded" if route == "sharded" else
+            "serve_placed_single"
+        )
+        mesh_ex = self._mesh_executor
+        singles = [ex for ex in self._executors if ex.can_accept()]
+        singles.sort(key=lambda ex: (ex.load(), ex.index))
+        if route == "sharded":
+            chosen = (
+                mesh_ex
+                if mesh_ex.can_accept()
+                else (singles[0] if singles else mesh_ex)
+            )
+        else:
+            chosen = (
+                singles[0]
+                if singles
+                else (
+                    mesh_ex
+                    if mesh_ex is not None and mesh_ex.can_accept()
+                    else min(
+                        self._executors,
+                        key=lambda ex: (ex.load(), ex.index),
+                    )
+                )
+            )
+        if (route == "sharded") != (chosen.placement == "sharded"):
+            metrics.count("serve_placed_spill")
+        metrics.set_gauge("serve_queue_depth", self._queue.depth())
+        return chosen
+
+    # -- batch work (runs on executor threads) -------------------------------
+
+    def _launch(self, requests, executor=None):
+        """Assemble + dispatch one coalesced batch NOW on `executor`'s
+        device; return the settle closure state. Mirrors
+        stream.verify_stream's launch(): the first dispatch attempt is
+        consumed eagerly (pipelining), finalize() re-runs the full
+        dispatch+readback cycle under the retry ladder, then the
+        fallback."""
+        if executor is None:
+            executor = self._executors[0]
+        with self._seq_lock:
+            seq = self._batch_seq
+            self._batch_seq += 1
+        metrics.count("serve_dev%s_dispatches" % executor.label)
+        metrics.count("serve_dev%s_requests" % executor.label, len(requests))
         bspan = otrace.start_span(
             "batch",
             root=True,
             seq=seq,
             n=len(requests),
+            device=executor.label,
+            placement=executor.placement,
             members=[r.future.trace_id for r in requests]
             if otrace.enabled()
             else None,
         )
         for r in requests:
             # the request->batch join: a request's trace knows which
-            # batch trace did its device work (flight dumps follow it)
+            # batch trace (hence which DEVICE) did its device work
             r.span.set(batch_trace=bspan.trace_id, batch_seq=seq)
-        with otrace.use(bspan):
+        with otrace.use(bspan), metrics.timer(executor.busy_timer):
             with otrace.span("coalesce"):
                 if self.pad_partial:
                     sigs, messages_list, n_pad = pad_batch(
@@ -258,9 +584,13 @@ class CredentialService:
             attempts = []
             box = [None]
             permanent = None
-            with otrace.span("dispatch", backend=type(self.backend).__name__):
+            with otrace.span(
+                "dispatch",
+                backend=type(self.backend).__name__,
+                device=executor.label,
+            ):
                 try:
-                    box[0] = self._dispatch(
+                    box[0] = executor.dispatch(
                         sigs, messages_list, self.vk, self.params
                     )
                 except self._policy.retryable as e:
@@ -282,7 +612,7 @@ class CredentialService:
         def cycle():
             fin, box[0] = box[0], None
             if fin is None:
-                fin = self._dispatch(
+                fin = executor.dispatch(
                     sigs, messages_list, self.vk, self.params
                 )
             return fin()
@@ -308,20 +638,39 @@ class CredentialService:
                 fallback=fallback,
             )
 
-        return (seq, requests, sigs, messages_list, finalize, attempts, bspan)
+        return (
+            seq,
+            requests,
+            sigs,
+            messages_list,
+            finalize,
+            attempts,
+            bspan,
+            executor,
+        )
 
     def _settle(
-        self, seq, requests, sigs, messages_list, finalize, attempts, bspan
+        self,
+        seq,
+        requests,
+        sigs,
+        messages_list,
+        finalize,
+        attempts,
+        bspan,
+        executor=None,
     ):
         """Block on the batch result and resolve every request's future."""
-        with otrace.use(bspan):
+        if executor is None:
+            executor = self._executors[0]
+        with otrace.use(bspan), metrics.timer(executor.busy_timer):
             try:
-                with otrace.span("device"):
+                with otrace.span("device", device=executor.label):
                     result = finalize()
             except Exception as e:
                 # batch-level failure past retry+fallback: each
                 # cohabiting future gets the exception — never a silent
-                # hang
+                # hang, and never another device's problem
                 fail_all(requests, e)
                 bspan.end(error=type(e).__name__)
                 return
@@ -337,7 +686,8 @@ class CredentialService:
             # bisection so one forged credential fails only its own
             # future; culprit dead-letter lines carry the CULPRIT
             # request's trace_id (not the batch's), so an operator greps
-            # straight from a JSONL line to the request's span tree
+            # straight from a JSONL line to the request's span tree —
+            # which names the device via its batch span
             culprits = (
                 set(
                     self._bisector(
@@ -361,36 +711,28 @@ class CredentialService:
             )
             bspan.end(result="bisected", n_culprits=len(culprits))
 
+    # -- placer --------------------------------------------------------------
+
+    def _crash(self, e):
+        """Placer/executor-loop crash: sweep every queued and inbox future
+        with the crash exception — no caller ever hangs."""
+        self._crashed = e
+        self._queue.close()
+        fail_all(self._queue.drain_pending(), e)
+        for ex in self._all_executors():
+            ex.poison(e)
+
     def _run(self):
-        pending = None
         try:
             while True:
-                batch = self._batcher.next_batch(block=pending is None)
-                if batch:
-                    launched = self._launch(batch)
-                    if pending is not None:
-                        self._settle(*pending)
-                        pending = None
-                    if self._is_async:
-                        # double-buffer: leave this batch in flight and go
-                        # coalesce+encode the next while the device runs
-                        pending = launched
-                    else:
-                        self._settle(*launched)
-                    continue
-                if pending is not None:
-                    # nothing ready to overlap with: settle the in-flight
-                    # batch now instead of holding its latency hostage
-                    self._settle(*pending)
-                    pending = None
-                    continue
-                # blocking pop returned empty: closed and fully drained
-                return
-        except BaseException as e:  # supervisor crash: sweep every future
-            self._crashed = e
-            if pending is not None:
-                fail_all(pending[1], e)
-                otrace.end_span(pending[6], error=type(e).__name__)
-            self._queue.close()
-            fail_all(self._queue.drain_pending(), e)
+                batch = self._batcher.next_batch(
+                    block=True, ready=self._has_capacity
+                )
+                if batch is None:
+                    # closed and fully routed: executors drain their
+                    # inboxes; drain()/shutdown() closes and joins them
+                    return
+                self._place(batch).submit_batch(batch)
+        except BaseException as e:
+            self._crash(e)
             raise

@@ -229,7 +229,26 @@ class StreamState:
         os.replace(tmp, self.path)  # atomic on POSIX
 
 
-def _dispatchers(backend, mode, mesh=None):
+def _pin_to_device(dispatch, device):
+    """Wrap a dispatch callable so its host encode + launch run with
+    `device` as the jax default device — the per-device executor pool's
+    placement seam (serve/service.py): operands created inside commit to
+    that device, so each executor's batches land on ITS chip and the jit
+    executable cache stays per-device-hot. device=None is the identity
+    (stub/sync backends, single-device services)."""
+    if device is None:
+        return dispatch
+
+    def pinned(s, m, vk, params):
+        import jax
+
+        with jax.default_device(device):
+            return dispatch(s, m, vk, params)
+
+    return pinned
+
+
+def _dispatchers(backend, mode, mesh=None, device=None, mesh_pad_to=None):
     """(dispatch, record, is_async) for the chosen mode. dispatch(sigs,
     msgs, vk, params) -> zero-arg finalizer; record(state, result,
     batch_size). is_async=False means dispatch computes synchronously —
@@ -238,8 +257,18 @@ def _dispatchers(backend, mode, mesh=None):
 
     mesh: run the grouped mode dp-sharded over a jax Mesh (config 5 on
     multi-chip — SURVEY §2.3 PP+DP rows combined: the batch is sharded
-    across devices AND host encode pipelines under device execution)."""
+    across devices AND host encode pipelines under device execution).
+    device: pin single-chip dispatch to one jax device (mutually
+    exclusive with mesh — a sharded program owns its own placement).
+    mesh_pad_to: fixed grouped-mode batch pad on the mesh path, so a
+    serving workload with varying coalesced sizes keeps ONE cache-hot
+    program shape instead of compiling per occupancy level."""
     if mesh is not None:
+        if device is not None:
+            raise ValueError(
+                "mesh and device are mutually exclusive: a sharded "
+                "program spans the mesh, it cannot also pin to one device"
+            )
         if mode not in ("grouped", "per_credential"):
             raise ValueError(
                 "mesh streaming supports mode='grouped' or "
@@ -277,7 +306,7 @@ def _dispatchers(backend, mode, mesh=None):
 
         def dispatch(s, m, vk, params):
             return _shard.batch_verify_grouped_sharded_async(
-                backend, s, m, vk, params, mesh
+                backend, s, m, vk, params, mesh, pad_batch_to=mesh_pad_to
             )
 
         return dispatch, _record_grouped, True
@@ -292,7 +321,11 @@ def _dispatchers(backend, mode, mesh=None):
         else:
             dispatch = async_fn
 
-        return dispatch, _record_percred, async_fn is not None
+        return (
+            _pin_to_device(dispatch, device),
+            _record_percred,
+            async_fn is not None,
+        )
     if mode == "grouped":
         async_fn = getattr(backend, "batch_verify_grouped_async", None)
         if async_fn is None:
@@ -309,7 +342,11 @@ def _dispatchers(backend, mode, mesh=None):
         else:
             dispatch = async_fn
 
-        return dispatch, _record_grouped, async_fn is not None
+        return (
+            _pin_to_device(dispatch, device),
+            _record_grouped,
+            async_fn is not None,
+        )
     raise ValueError("unknown stream mode %r" % (mode,))
 
 

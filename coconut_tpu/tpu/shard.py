@@ -300,6 +300,21 @@ def pad_to_multiple(k, n):
     return ((k + n - 1) // n) * n
 
 
+class _IdentityLane:
+    """Identity-signature pad lane (`sigma_1 is None`): verifies False by
+    the reference rule (signature.rs:472-478) and encodes as the point at
+    infinity, so a pad lane can never flip a real lane's verdict — the
+    same identity-lane convention serve/batcher.PAD_CREDENTIAL and
+    `encode_verify_batch(pad_bases_to=...)` use."""
+
+    __slots__ = ()
+    sigma_1 = None
+    sigma_2 = None
+
+
+PAD_LANE = _IdentityLane()
+
+
 def batch_verify_sharded_async(
     backend, sigs, messages_list, vk, params, mesh, batch_axis="dp",
     msm_axis="tp",
@@ -311,10 +326,12 @@ def batch_verify_sharded_async(
     the mesh busy across the readback round trip.
 
     The final batch of a stream rarely divides the dp extent; it is padded
-    by repeating the last credential up to the next multiple and the
-    verdict bits are sliced back to the true length, so callers never see
-    the padding (a duplicated real credential re-verifies to the same bit;
-    verdicts are per-lane, so pad lanes cannot affect real ones)."""
+    with IDENTITY lanes up to the next multiple (ADVICE r5 #1 — matching
+    the grouped mesh path's identity-lane encode convention rather than
+    duplicating a real credential) and the verdict bits are sliced back to
+    the true length, so callers never see the padding (identity lanes
+    verify False; verdicts are per-lane, so pad lanes cannot affect real
+    ones)."""
     require_axes(mesh, batch_axis, msm_axis)
     ndp = mesh.shape[batch_axis]
     ntp = mesh.shape[msm_axis]  # the sharded program requires both axes
@@ -323,7 +340,7 @@ def batch_verify_sharded_async(
         return lambda: []
     pad = (-B) % ndp
     if pad:
-        sigs = list(sigs) + [sigs[-1]] * pad
+        sigs = list(sigs) + [PAD_LANE] * pad
         messages_list = list(messages_list) + [messages_list[-1]] * pad
     k = 1 + len(vk.Y_tilde)
     operands = backend.encode_verify_batch(

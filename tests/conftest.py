@@ -67,3 +67,9 @@ def pytest_configure(config):
         "Perfetto export, flight recorder, percentile edge cases), also "
         "run explicitly by ci.sh's obs lane",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute tests (virtual-mesh program tracing/execution) "
+        "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
+        "ci.sh's full-suite pass still runs them",
+    )

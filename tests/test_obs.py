@@ -112,6 +112,23 @@ def test_disabled_path_never_allocates_a_span(monkeypatch):
         pass
 
 
+def test_disabled_pool_dispatch_path_never_allocates_a_span(monkeypatch):
+    """ISSUE 8 extension of the poison walk: a full submit -> place ->
+    per-device dispatch -> settle -> demux cycle through the dispatcher
+    POOL (two executors) allocates zero Spans while tracing is off. A
+    poisoned allocation would crash an executor loop, sweep the futures
+    with the AssertionError, and fail the result() asserts below."""
+
+    def boom(*a, **k):
+        raise AssertionError("Span allocated while tracing disabled")
+
+    monkeypatch.setattr(otrace, "Span", boom)
+    svc = CredentialService(StubPerCred(), None, None, max_batch=2, devices=2)
+    with svc:
+        futs = [svc.submit(_cred(), [0]) for _ in range(6)]
+        assert all(f.result(10.0) for f in futs)
+
+
 def test_env_flag_parse():
     for off in (None, "", "0", "false", "OFF", "no"):
         assert not otrace._env_enabled(off)
@@ -438,6 +455,12 @@ def test_serve_request_span_tree_retry_and_bisection(_traced, clock, tmp_path):
     assert [e["name"] for e in req_span.events] == ["dead_letter"]
     assert req_span.attrs["verdict"] is False
     assert bspan.attrs["result"] == "bisected"
+    # the dead-lettered request's span tree names the device that rejected
+    # it and which side of the placement policy its batch took (ISSUE 8)
+    assert bspan.attrs["device"] == "0"
+    assert bspan.attrs["placement"] == "single"
+    assert spans["dispatch"].attrs["device"] == "0"
+    assert spans["device"].attrs["device"] == "0"
     # dead-letter line joins back on the victim's trace_id
     (rec,) = DeadLetterLog.read(dlq)
     assert rec["trace_id"] == victim.trace_id and rec["schema"] == 2

@@ -233,6 +233,33 @@ def test_sharded_percred_stream(mesh_devices, fixture8, tmp_path):
     assert state.next_batch == 3
 
 
+def test_sharded_percred_ragged_batch_pads_with_identity_lanes(
+    mesh_devices, fixture8
+):
+    """A final batch NOT divisible by ndp pads with identity lanes
+    (shard.PAD_LANE, sigma_1 is None) up to a multiple of ndp and slices
+    the verdict bits back to len(sigs) — the ragged tail of a ledger
+    stream verifies on the mesh instead of raising, and a pad lane can
+    never flip a real lane's verdict. B=3 on the (4,2) mesh pads to 4:
+    the exact program shape the tests above already compile."""
+    from coconut_tpu.tpu.backend import JaxBackend
+    from coconut_tpu.tpu.shard import batch_verify_sharded_async, default_mesh
+
+    params, _, vk, sigs, msgs_list = fixture8
+    sigs, msgs_list = list(sigs[:3]), msgs_list[:3]
+    sigs[1] = Signature(
+        sigs[1].sigma_1, params.ctx.sig.mul(sigs[1].sigma_2, 2)
+    )
+    mesh = default_mesh(ndp=4, ntp=2, devices=mesh_devices)
+    bits = batch_verify_sharded_async(
+        JaxBackend(), sigs, msgs_list, vk, params, mesh
+    )()
+    want = [ps_verify(s, m, vk, params) for s, m in zip(sigs, msgs_list)]
+    assert want == [True, False, True]
+    assert bits == want
+    assert len(bits) == 3
+
+
 def test_sharded_issuance_rejects_indivisible_batch(mesh_devices, fixture8):
     """ShardedIssuanceBackend fails fast (before any device work) when a
     row count does not divide the dp extent."""
