@@ -44,6 +44,15 @@ efficiency goodput_n/(n*goodput_1) — embedded under "serve"."scaling"
 (BENCH_SERVE_SWEEP_SECONDS trims the per-point duration; on the jax
 backend each executor pins to a real device, elsewhere executors are
 unpinned workers).
+
+Chaos-recovery sub-report (ISSUE 9, on by default with --serve;
+BENCH_CHAOS=0 skips): a three-phase loadgen pass — clean, then one
+injected executor crash + one hung dispatch, then post-fault — against a
+BENCH_CHAOS_DEVICES-wide pool (default 4) with a fast watchdog and
+probation ladder, embedded under "serve"."chaos_recovery": goodput
+before/during/after, the recovery ratio, and the quarantine/watchdog/
+redistribution counters. BENCH_CHAOS_SECONDS sets the per-phase duration
+(default 0.8).
 """
 
 import json
@@ -189,7 +198,112 @@ def bench_serve(ge, params, vk, sigs, msgs_list, extras, backend_name):
         extras["serve"]["scaling"] = _bench_serve_scaling(
             params, vk, pool, backend_name, mode, max_batch, max_wait_ms
         )
+    if os.environ.get("BENCH_CHAOS", "1") == "1":
+        extras["serve"]["chaos_recovery"] = _bench_chaos_recovery(
+            params, vk, pool, backend_name, mode, max_batch, max_wait_ms
+        )
     return report["goodput_per_s"]
+
+
+def _bench_chaos_recovery(params, vk, pool, backend_name, mode, max_batch,
+                          max_wait_ms):
+    """Self-healing recovery datapoint (ISSUE 9): goodput before / during /
+    after a scheduled mid-run fault pair (one executor-loop crash + one
+    hung dispatch) against a pool with a fast watchdog and probation
+    ladder. The number that matters is recovery_ratio = after/before: a
+    pool that quarantines the culprits and re-admits them after a probe
+    holds it near 1.0; a pool that bleeds capacity does not.
+    BENCH_CHAOS=0 skips, BENCH_CHAOS_DEVICES / BENCH_CHAOS_SECONDS size
+    the experiment."""
+    from coconut_tpu import metrics
+    from coconut_tpu.backend import get_backend
+    from coconut_tpu.faults import ChaosSchedule
+    from coconut_tpu.serve import CredentialService, run_loadgen
+    from coconut_tpu.serve.health import HealthPolicy, Watchdog
+
+    n_devices = int(os.environ.get("BENCH_CHAOS_DEVICES", "4"))
+    seconds = float(os.environ.get("BENCH_CHAOS_SECONDS", "0.8"))
+    concurrency = 2 * max_batch
+    sched = ChaosSchedule()  # indices scheduled mid-run, below
+    fb = sched.wrap(get_backend(backend_name))
+    counters0 = {
+        name: metrics.get_count(name)
+        for name in (
+            "serve_executor_crashes",
+            "serve_watchdog_timeouts",
+            "serve_quarantined",
+            "serve_recovered",
+            "serve_redistributed_batches",
+        )
+    }
+    svc = CredentialService(
+        fb,
+        vk,
+        params,
+        mode=mode,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        max_depth=max(1024, 4 * max_batch * n_devices),
+        devices=n_devices,
+        watchdog=Watchdog(
+            k=4.0, min_timeout_s=0.2, initial_timeout_s=120.0,
+            max_timeout_s=120.0,
+        ),
+        watchdog_interval_s=0.05,
+        health_policy=HealthPolicy(probe_after_s=0.3, probe_successes=1),
+    )
+    with svc:
+        warm = [
+            svc.submit(*pool[i % len(pool)][:2])
+            for i in range(max_batch * n_devices)
+        ]
+        for f in warm:
+            f.result(timeout=600.0)
+
+        def phase(duration):
+            return run_loadgen(
+                svc, pool, duration_s=duration,
+                arrival="closed", concurrency=concurrency,
+            )
+
+        before = phase(seconds)
+        # schedule the faults at near-future dispatch indices (mirrored
+        # onto the schedule object so describe() reports what actually ran)
+        fb.crash_on = sched.crash_on = frozenset({fb.dispatches + 2})
+        fb.hang_on = sched.hang_on = frozenset({fb.dispatches + 4})
+        during = phase(max(seconds, 1.0))
+        sched.release_hangs()
+        time.sleep(0.4)  # one probation cooldown's room
+        after = phase(seconds)
+    for rep in (before, during, after):
+        assert rep["dropped_futures"] == 0, (
+            "chaos recovery dropped futures: %r" % (rep,)
+        )
+    ratio = (
+        round(after["goodput_per_s"] / before["goodput_per_s"], 4)
+        if before["goodput_per_s"]
+        else None
+    )
+    return {
+        "devices": n_devices,
+        "seconds_per_phase": seconds,
+        "schedule": sched.describe(),
+        "goodput_per_s": {
+            "before": before["goodput_per_s"],
+            "during": during["goodput_per_s"],
+            "after": after["goodput_per_s"],
+        },
+        "errors": {
+            "before": before["errors"],
+            "during": during["errors"],
+            "after": after["errors"],
+        },
+        "recovery_ratio": ratio,
+        "counters": {
+            name: metrics.get_count(name) - start
+            for name, start in sorted(counters0.items())
+        },
+    }
 
 
 def _bench_serve_scaling(params, vk, pool, backend_name, mode, max_batch,

@@ -65,7 +65,7 @@ python -m pytest tests/test_serve.py -m "serve and not slow" -q
 # latency percentiles present. bench_serve itself asserts the invariants
 # loudly; the JSON probe re-checks them from the artifact a human reads.
 SERVE_JSON=$(mktemp -d)/serve.json
-BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 \
+BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 BENCH_CHAOS=0 \
   BENCH_SERVE_SECONDS=2 BENCH_SERVE_MAX_BATCH=4 JAX_PLATFORMS=cpu \
   python bench.py --serve > "$SERVE_JSON"
 SERVE_JSON_PATH="$SERVE_JSON" python - <<'EOF'
@@ -92,7 +92,7 @@ EOF
 # is covered in-suite by tests/test_serve.py::test_mesh_serve_integration*
 # on the same virtual mesh.)
 MESH_SERVE_JSON=$(mktemp -d)/mesh_serve.json
-BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 \
+BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 BENCH_CHAOS=0 \
   BENCH_SERVE_SECONDS=1 BENCH_SERVE_MAX_BATCH=4 BENCH_TRACE_OVERHEAD=0 \
   BENCH_SERVE_DEVICES="1,8" BENCH_SERVE_SWEEP_SECONDS=0.5 \
   JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
@@ -115,6 +115,38 @@ print("mesh-serve smoke: ok (%d devices dispatched at n=8, "
       "efficiency %.2f)" % (wide["devices_with_dispatches"],
                             wide["scaling_efficiency"]))
 EOF
+
+echo "== chaos lane (self-healing pool: crash containment / watchdog / brownout) =="
+# the marker suite: breaker/watchdog/brownout units (tests/test_health.py),
+# fake-clock crash/hang/quarantine/probation integration (test_serve.py),
+# injection + rotation + crash-atomic checkpoint satellites (test_faults.py)
+python -m pytest tests/ -m chaos -q
+# end-to-end acceptance smoke (ISSUE 9): a real 8-executor stub-device
+# service takes one injected executor crash AND one hung dispatch mid-run;
+# the probe asserts every submitted future settled, the culprits were
+# quarantined (crash + watchdog paths both fired), and goodput recovered
+# to >= half the pre-fault level after the probation ladder re-admits
+JAX_PLATFORMS=cpu python probes/probe_chaos.py
+# chaos-recovery bench datapoint: goodput before/during/after a scheduled
+# crash+hang pair, from the same JSON artifact a human reads
+CHAOS_JSON=$(mktemp -d)/chaos.json
+BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 BENCH_TRACE_OVERHEAD=0 \
+  BENCH_SERVE_SECONDS=0.5 BENCH_SERVE_MAX_BATCH=4 BENCH_CHAOS_SECONDS=0.5 \
+  JAX_PLATFORMS=cpu python bench.py --serve > "$CHAOS_JSON"
+CHAOS_JSON_PATH="$CHAOS_JSON" python - <<'PYEOF'
+import json, os
+with open(os.environ["CHAOS_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+cr = json.loads(line)["serve"]["chaos_recovery"]
+assert cr["counters"]["serve_executor_crashes"] >= 1, cr
+assert cr["counters"]["serve_quarantined"] >= 1, cr
+assert all(v == 0 for v in cr["errors"].values()), cr
+assert cr["recovery_ratio"] is not None and cr["recovery_ratio"] >= 0.5, cr
+print("chaos bench smoke: ok (recovery ratio %.2f, %d quarantined, "
+      "%d watchdog timeouts)" % (cr["recovery_ratio"],
+                                 cr["counters"]["serve_quarantined"],
+                                 cr["counters"]["serve_watchdog_timeouts"]))
+PYEOF
 
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q

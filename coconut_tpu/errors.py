@@ -69,6 +69,32 @@ class ServiceOverloadedError(CoconutError):
         self.max_depth = max_depth
 
 
+class ServiceBrownoutError(CoconutError):
+    """The serving layer is in BROWNOUT: quarantined executors cut the
+    pool's capacity, or sustained queue pressure crossed the brownout
+    threshold, and graded load-shedding (serve/health.BrownoutPolicy) is
+    refusing this request's lane — bulk sheds first, interactive rides
+    through to the hard admission bound. RETRIABLE by design: carries
+    `retry_after_s`, the service's pressure-scaled hint for when capacity
+    should be back (probation probes re-admitting devices, or the queue
+    draining). Counted under "serve_shed_bulk"."""
+
+    def __init__(self, lane, retry_after_s, depth=None, capacity_fraction=None):
+        detail = []
+        if capacity_fraction is not None:
+            detail.append("capacity %d%%" % round(capacity_fraction * 100))
+        if depth is not None:
+            detail.append("depth %d" % depth)
+        super().__init__(
+            "service brownout (%s): %s lane shed — retry after ~%.3gs"
+            % (", ".join(detail) or "degraded", lane, retry_after_s)
+        )
+        self.lane = lane
+        self.retry_after_s = retry_after_s
+        self.depth = depth
+        self.capacity_fraction = capacity_fraction
+
+
 class ServiceClosedError(CoconutError):
     """A request was submitted to (or was still queued in) a credential
     service that is draining or shut down (serve/service.py). Futures of

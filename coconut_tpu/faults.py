@@ -5,10 +5,27 @@ path:
 
   - `FaultyBackend` wraps any verify-capable backend and injects scheduled
     faults at exactly the seam `stream.verify_stream` dispatches through:
-    raise-on-Nth-dispatch transient errors, flipped verdicts, and corrupted
-    (raising) finalizers. Schedules are index-based and fully
-    deterministic, so tests/test_faults.py proves the retry / fallback /
-    bisection paths without flaky randomness.
+    raise-on-Nth-dispatch transient errors, flipped verdicts, corrupted
+    (raising) finalizers, executor-loop crashes, and hung dispatches.
+    Schedules are index-based and fully deterministic, so
+    tests/test_faults.py proves the retry / fallback / bisection paths —
+    and tests/test_serve.py the self-healing pool — without flaky
+    randomness.
+
+    Crash injection (`crash_on`): the matching dispatch raises
+    `InjectedCrash`, a BaseException — it deliberately ESCAPES the
+    per-batch `except Exception` containment in serve._launch/_settle,
+    exactly the way a real code bug in the dispatch path would, and lands
+    in the executor loop's crash handler (quarantine + redistribution).
+
+    Hang injection (`hang_on` / `hang_every`): the matching dispatch
+    BLOCKS on a threading.Event (`hang_release`) instead of returning —
+    the failure mode retry ladders cannot see and only the serve
+    watchdog can break. Deterministic and sleep-free: `hang_entered` is
+    set the moment a dispatch starts hanging (the test's sync point), the
+    test advances its fake clock, ticks the watchdog, then sets
+    `hang_release`; `hang_max_s` bounds an un-released hang so a buggy
+    test can never wedge the suite.
 
     Latency injection (the serving layer's deadline-flush and timeout
     tests need SLOW dispatches, not just failed ones): `delay_every=N` /
@@ -25,7 +42,10 @@ path:
     line with the batch index, the credential's index within the batch,
     a reason, and the batch's retry attempt history. JSONL so a ledger
     operator can grep/stream it without loading a document; ci.sh greps
-    the schema as a smoke check.
+    the schema as a smoke check. BOUNDED: the file rotates
+    (`<path>.1`, `.2`, ..., keep-N — obs/flight.rotate_if_needed) at a
+    size or record-count cap, so a sustained fault storm cannot fill the
+    disk; the flight-recorder sidecar is capped the same way.
 
     Schema v2 (request-scoped tracing): entries carry `trace_id` /
     `span_id` so a dead-letter line joins back to its span tree (the
@@ -40,6 +60,7 @@ path:
 
 import json
 import os
+import threading
 import time
 
 from .errors import TransientBackendError
@@ -48,6 +69,14 @@ from .obs import trace as otrace
 
 #: dead-letter JSONL schema: v2 added trace_id/span_id (absent -> null)
 DEAD_LETTER_SCHEMA = 2
+
+
+class InjectedCrash(BaseException):
+    """Deterministic executor-loop crash injection. Derives from
+    BaseException ON PURPOSE: the serve layer's per-batch containment
+    (`except Exception` in _launch/_settle) must NOT catch it — it
+    escapes to the executor loop's crash handler, modeling a genuine code
+    bug in the dispatch path rather than a batch-level backend fault."""
 
 # the verify entry points verify_stream._dispatchers probes for; faults are
 # injected only on these, everything else delegates untouched
@@ -83,6 +112,21 @@ class FaultyBackend:
                        `sleep` is injectable (default time.sleep) so those
                        tests can record the scheduled delays instead of
                        actually waiting.
+      crash_on       — dispatch indices that raise `InjectedCrash` (a
+                       BaseException: escapes per-batch containment and
+                       crashes the executor LOOP — the quarantine +
+                       redistribution path, not the retry ladder);
+      hang_every=N / hang_on — dispatch indices that BLOCK on the
+                       `hang_release` event instead of returning (a wedged
+                       device: only the serve watchdog frees its batch).
+                       `hang_entered` is set when a hang begins (the
+                       test's deterministic sync point); `hang_max_s`
+                       bounds an un-released hang.
+
+    Schedule sets are plain attributes and may be reassigned mid-run
+    (e.g. ``fb.crash_on = frozenset({fb.dispatches})`` to crash the NEXT
+    dispatch) — the probe/bench chaos phases schedule faults relative to
+    the live dispatch counter this way.
 
     `error` is the exception class raised (default TransientBackendError;
     pass e.g. RuntimeError to model a permanent fault)."""
@@ -97,6 +141,11 @@ class FaultyBackend:
         delay_every=None,
         delay_on=(),
         delay_s=0.0,
+        crash_on=(),
+        hang_every=None,
+        hang_on=(),
+        hang_release=None,
+        hang_max_s=30.0,
         sleep=time.sleep,
         error=TransientBackendError,
     ):
@@ -108,6 +157,16 @@ class FaultyBackend:
         self.delay_every = delay_every
         self.delay_on = frozenset(delay_on)
         self.delay_s = delay_s
+        self.crash_on = frozenset(crash_on)
+        self.hang_every = hang_every
+        self.hang_on = frozenset(hang_on)
+        self.hang_release = (
+            hang_release if hang_release is not None else threading.Event()
+        )
+        self.hang_entered = threading.Event()
+        self.hang_max_s = hang_max_s
+        self.hangs = 0
+        self.crashes = 0
         self.sleep = sleep
         self.error = error
         self.dispatches = 0
@@ -131,6 +190,27 @@ class FaultyBackend:
         if self.delay_s and self._dispatch_delayed(idx):
             self.sleep(self.delay_s)
 
+    def _dispatch_hangs(self, idx):
+        if self.hang_every and (idx + 1) % self.hang_every == 0:
+            return True
+        return idx in self.hang_on
+
+    def _maybe_crash(self, idx, name):
+        if idx in self.crash_on:
+            self.crashes += 1
+            raise InjectedCrash(
+                "injected executor crash #%d (%s)" % (idx, name)
+            )
+
+    def _maybe_hang(self, idx):
+        if self._dispatch_hangs(idx):
+            self.hangs += 1
+            # deterministic hang: block until the harness releases it —
+            # no sleeps, and hang_max_s keeps an un-released hang from
+            # wedging a whole test run
+            self.hang_entered.set()
+            self.hang_release.wait(self.hang_max_s)
+
     def _mangle(self, idx, result):
         if idx in self.flip_on:
             if isinstance(result, list):
@@ -144,10 +224,12 @@ class FaultyBackend:
 
             def sync_injected(*args, **kwargs):
                 idx = self._tick()
+                self._maybe_crash(idx, name)
                 if self._dispatch_faulted(idx):
                     raise self.error(
                         "injected dispatch fault #%d (%s)" % (idx, name)
                     )
+                self._maybe_hang(idx)
                 self._maybe_delay(idx)
                 result = attr(*args, **kwargs)
                 if idx in self.corrupt_finalizer_on:
@@ -161,6 +243,7 @@ class FaultyBackend:
 
             def async_injected(*args, **kwargs):
                 idx = self._tick()
+                self._maybe_crash(idx, name)
                 if self._dispatch_faulted(idx):
                     raise self.error(
                         "injected dispatch fault #%d (%s)" % (idx, name)
@@ -169,6 +252,9 @@ class FaultyBackend:
                 fin = attr(*args, **kwargs)
 
                 def finalize():
+                    # async seams hang at READBACK: the launch returned,
+                    # the result never arrives
+                    self._maybe_hang(idx)
                     if idx in self.corrupt_finalizer_on:
                         raise self.error(
                             "injected finalizer fault #%d (%s)" % (idx, name)
@@ -181,6 +267,65 @@ class FaultyBackend:
         return attr
 
 
+class ChaosSchedule:
+    """A declarative chaos experiment: WHICH 0-based dispatch indices
+    crash, hang, fault, flip, or stall — one object a test, probe, or
+    bench lane can both APPLY (`wrap()` a backend) and DESCRIBE
+    (`describe()` into a report). Everything stays deterministic: the
+    schedule is pure data, the wrapped FaultyBackend's single dispatch
+    counter drives it, and `release_hangs()` is the only side-effectful
+    control (freeing every hung dispatch across every wrapped backend —
+    call it before drain so abandoned workers exit promptly)."""
+
+    def __init__(
+        self,
+        crash_on=(),
+        hang_on=(),
+        fault_on=(),
+        flip_on=(),
+        delay_on=(),
+        delay_s=0.0,
+    ):
+        self.crash_on = frozenset(crash_on)
+        self.hang_on = frozenset(hang_on)
+        self.fault_on = frozenset(fault_on)
+        self.flip_on = frozenset(flip_on)
+        self.delay_on = frozenset(delay_on)
+        self.delay_s = delay_s
+        self.backends = []
+
+    def wrap(self, inner, **kwargs):
+        """FaultyBackend over `inner` carrying this schedule; extra
+        kwargs (sleep, error, hang_max_s, ...) pass through."""
+        fb = FaultyBackend(
+            inner,
+            raise_on=self.fault_on,
+            flip_on=self.flip_on,
+            delay_on=self.delay_on,
+            delay_s=self.delay_s,
+            crash_on=self.crash_on,
+            hang_on=self.hang_on,
+            **kwargs,
+        )
+        self.backends.append(fb)
+        return fb
+
+    def release_hangs(self):
+        for fb in self.backends:
+            fb.hang_release.set()
+
+    def describe(self):
+        """JSON-ready description for bench/probe reports."""
+        return {
+            "crash_on": sorted(self.crash_on),
+            "hang_on": sorted(self.hang_on),
+            "fault_on": sorted(self.fault_on),
+            "flip_on": sorted(self.flip_on),
+            "delay_on": sorted(self.delay_on),
+            "delay_s": self.delay_s,
+        }
+
+
 class DeadLetterLog:
     """Append-only JSONL sink for credentials the stream could not accept.
 
@@ -190,10 +335,26 @@ class DeadLetterLog:
     where `credential` is the index WITHIN the batch, `attempts` is the
     batch's retry attempt history (retry.note_attempt records), and
     trace_id/span_id join the line to its request's span tree (null with
-    tracing disabled)."""
+    tracing disabled).
 
-    def __init__(self, path):
+    Disk-bounded: before an append that would cross `max_bytes` or
+    `max_records`, the file rotates aside (`<path>.1` newest ..
+    `<path>.<keep>` oldest, via obs/flight.rotate_if_needed — the same
+    cap discipline the flight-recorder sidecar uses). `read()` reads ONE
+    file; pass the rotated names explicitly to walk history."""
+
+    def __init__(
+        self,
+        path,
+        max_bytes=_flight.FLIGHT_MAX_BYTES,
+        max_records=None,
+        keep=_flight.FLIGHT_KEEP,
+    ):
         self.path = path
+        self.max_bytes = max_bytes
+        self.max_records = max_records
+        self.keep = keep
+        self._records = None  # lazy line count of the live file
 
     def append(
         self, batch, credential, reason, attempts=(), trace_id=None, span_id=None
@@ -218,8 +379,23 @@ class DeadLetterLog:
             "trace_id": trace_id,
             "span_id": span_id,
         }
+        if self._records is None:
+            self._records = (
+                len(DeadLetterLog.read(self.path))
+                if self.max_records is not None
+                else 0
+            )
+        if _flight.rotate_if_needed(
+            self.path,
+            max_bytes=self.max_bytes,
+            max_records=self.max_records,
+            keep=self.keep,
+            record_count=self._records,
+        ):
+            self._records = 0
         with open(self.path, "a") as f:
             f.write(json.dumps(rec, sort_keys=True) + "\n")
+        self._records += 1
         _flight.record(
             self.path,
             "dead_letter",

@@ -43,6 +43,19 @@ the routing state the least-loaded picker saw. `counters_with_prefix` /
 `timers_with_prefix` read a whole label family (e.g. "serve_dev")
 without enumerating device ids.
 
+The SELF-HEALING pool (serve/health.py + serve/service.py) reports its
+recovery ladder here: "serve_quarantined" (circuit-breaker opens),
+"serve_probes" (half-open probe batches placed on PROBATION executors),
+"serve_probe_failures", "serve_recovered" (breakers closed back to
+HEALTHY), "serve_watchdog_timeouts" (hung dispatches expired),
+"serve_executor_crashes" (executor-loop crashes contained),
+"serve_redistributed_batches" / "serve_redistributed_requests" (unsettled
+work re-placed onto survivors), "serve_redispatch_exhausted" (poisonous
+batches failed after the hop cap), "serve_shed_bulk" (brownout sheds),
+and "rotations" / "rotation_errors" (dead-letter/flight JSONL rotation).
+Gauges: "serve_dev<d>_health" (the state string), "serve_healthy_executors"
+(admissible pool size), "serve_brownout" (0/1 shed-mode flag).
+
 THREAD SAFETY: the serving layer is the first multi-threaded writer
 (admission happens on client threads while the supervisor thread settles
 batches), so every mutation and `snapshot()` runs under one module lock —

@@ -20,9 +20,18 @@ Zero-cost when tracing is disabled: `record()` returns None without
 touching the filesystem. Failures to WRITE the flight record are
 swallowed (`flight_write_errors` counter) — the recorder must never turn
 a handled fault into a crash.
+
+BOUNDED ON DISK: both this file and the dead-letter JSONL it rides next
+to are written by fault paths, and a sustained fault storm must not fill
+the disk. `rotate_if_needed()` implements size/record-count JSONL
+rotation (`<path>.1` newest rotated, up to `<path>.<keep>`); the flight
+recorder applies it with `FLIGHT_MAX_BYTES`/`FLIGHT_KEEP`, and
+faults.DeadLetterLog calls the same helper with its own knobs — one
+rotation discipline for every append-only fault artifact.
 """
 
 import json
+import os
 import time
 
 from .. import metrics
@@ -34,10 +43,50 @@ FLIGHT_SCHEMA = 1
 #: completed-span tail included in every flight record
 DEFAULT_LAST_N = 64
 
+#: per-file size cap before a flight/dead-letter JSONL rotates, and how
+#: many rotated generations (`<path>.1` .. `<path>.<keep>`) are retained
+FLIGHT_MAX_BYTES = 64 * 1024 * 1024
+FLIGHT_KEEP = 3
+
 
 def flight_path(base_path):
     """The flight-recorder file that rides next to `base_path`."""
     return "%s.flight.jsonl" % (base_path,)
+
+
+def rotate_if_needed(
+    path, max_bytes=None, max_records=None, keep=FLIGHT_KEEP, record_count=None
+):
+    """Rotate `path` aside (`path` -> `path.1` -> ... -> `path.keep`,
+    oldest dropped) when it has reached `max_bytes` or `max_records`
+    lines; call BEFORE appending. `record_count` lets a caller that
+    already tracks its line count skip the O(file) recount. Returns True
+    iff a rotation happened. None caps disable that check; rotation
+    errors are swallowed (a full-disk fault path must not crash its
+    handler) under the "rotation_errors" counter."""
+    try:
+        if keep < 1 or not os.path.exists(path):
+            return False
+        need = (
+            max_bytes is not None and os.path.getsize(path) >= max_bytes
+        )
+        if not need and max_records is not None:
+            if record_count is None:
+                with open(path, "rb") as f:
+                    record_count = sum(1 for line in f if line.strip())
+            need = record_count >= max_records
+        if not need:
+            return False
+        for i in range(keep - 1, 0, -1):
+            older = "%s.%d" % (path, i)
+            if os.path.exists(older):
+                os.replace(older, "%s.%d" % (path, i + 1))
+        os.replace(path, "%s.1" % (path,))
+        metrics.count("rotations")
+        return True
+    except OSError:
+        metrics.count("rotation_errors")
+        return False
 
 
 def record(base_path, reason, trace_id=None, extra=None, last_n=DEFAULT_LAST_N):
@@ -57,6 +106,9 @@ def record(base_path, reason, trace_id=None, extra=None, last_n=DEFAULT_LAST_N):
     }
     if extra:
         rec.update(extra)
+    rotate_if_needed(
+        flight_path(base_path), max_bytes=FLIGHT_MAX_BYTES, keep=FLIGHT_KEEP
+    )
     try:
         with open(flight_path(base_path), "a") as f:
             f.write(json.dumps(rec, sort_keys=True) + "\n")

@@ -20,12 +20,29 @@ servers solve, applied to credential verification:
               credential fails ITS future and is dead-lettered,
               cohabitants pass — per batch, hence per device),
               start/drain/shutdown
+  health.py   the self-healing layer: per-executor circuit-breaker state
+              machine (HEALTHY -> SUSPECT -> QUARANTINED -> PROBATION),
+              the hung-dispatch Watchdog (k x EMA deadline budgets), and
+              the BrownoutPolicy for graded load-shedding (bulk lane
+              sheds first, typed retriable ServiceBrownoutError)
   loadgen.py  closed- and open-loop (Poisson) load generation with
-              p50/p95/p99 latency, goodput, occupancy, rejection report
+              p50/p95/p99 latency, goodput, occupancy, rejection/shed
+              report
 
-See README.md "Online serving" for architecture and tuning guidance.
+See README.md "Online serving" and "Self-healing & overload" for
+architecture and tuning guidance.
 """
 
+from .health import (
+    HEALTHY,
+    PROBATION,
+    QUARANTINED,
+    SUSPECT,
+    BrownoutPolicy,
+    ExecutorHealth,
+    HealthPolicy,
+    Watchdog,
+)
 from .loadgen import run_loadgen
 from .queue import DEFAULT_MAX_WAIT_MS, LANES, RequestQueue, ServeFuture
 from .service import CredentialService
@@ -37,4 +54,12 @@ __all__ = [
     "run_loadgen",
     "LANES",
     "DEFAULT_MAX_WAIT_MS",
+    "HealthPolicy",
+    "ExecutorHealth",
+    "Watchdog",
+    "BrownoutPolicy",
+    "HEALTHY",
+    "SUSPECT",
+    "QUARANTINED",
+    "PROBATION",
 ]

@@ -94,11 +94,14 @@ class Batcher:
         the batcher HOLDS the backlog in the queue (where admission
         control can see and bound it) instead of popping work no device
         executor can accept yet; whoever frees capacity must kick() the
-        queue so the wait here re-checks."""
+        queue so the wait here re-checks. A CLOSED queue bypasses the
+        gate: at drain the backlog must flush (the placer's forced-spill
+        placement still settles it) rather than park forever behind a
+        pool that lost its capacity."""
         q = self.queue
         with q.cond:
             while True:
-                if ready is not None and not ready():
+                if ready is not None and not q.closed and not ready():
                     wait_s = _POLL_CAP_S
                 else:
                     flush, wait_s = self._ready_locked()

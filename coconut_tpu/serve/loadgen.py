@@ -44,7 +44,11 @@ import threading
 import time
 
 from .. import metrics
-from ..errors import ServiceClosedError, ServiceOverloadedError
+from ..errors import (
+    ServiceBrownoutError,
+    ServiceClosedError,
+    ServiceOverloadedError,
+)
 from ..obs import trace as otrace
 
 
@@ -135,6 +139,7 @@ class _Tally:
         self.latencies = []
         self.submitted = 0
         self.rejected = 0
+        self.shed = 0
         self.completed = 0
         self.errors = 0
         self.dropped = 0
@@ -211,6 +216,14 @@ def run_loadgen(
                 tally.submitted += 1
                 tally.rejected += 1
             return None
+        except ServiceBrownoutError:
+            # graded load-shedding (retriable, typed): counted apart from
+            # hard admission rejections so a report separates "queue
+            # full" from "pool degraded, retry later"
+            with tally.lock:
+                tally.submitted += 1
+                tally.shed += 1
+            return None
         except ServiceClosedError:
             return None
         with tally.lock:
@@ -258,6 +271,7 @@ def run_loadgen(
         "offered_rate_per_s": rate_per_s if arrival == "open" else None,
         "submitted": tally.submitted,
         "rejected": tally.rejected,
+        "shed": tally.shed,
         "completed": tally.completed,
         "errors": tally.errors,
         "dropped_futures": tally.dropped,

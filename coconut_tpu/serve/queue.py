@@ -109,6 +109,7 @@ class Request:
         "future",
         "span",
         "queue_span",
+        "redispatches",
     )
 
     def __init__(self, sig, messages, lane, max_wait_ms, t_submit):
@@ -120,6 +121,10 @@ class Request:
         self.max_wait_ms = max_wait_ms
         self.t_submit = t_submit
         self.future = ServeFuture()
+        # times this request was re-placed after its executor crashed or
+        # hung (serve/service.py redistribution); capped by the service's
+        # max_redispatch so a poisonous batch can't serially kill the pool
+        self.redispatches = 0
         # root span + queue-wait child start at ADMISSION (submit sets
         # them after the request clears admission control); both are the
         # shared no-op span while tracing is disabled

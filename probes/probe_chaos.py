@@ -1,0 +1,154 @@
+"""Self-healing acceptance smoke (the chaos lane's end-to-end check).
+
+    JAX_PLATFORMS=cpu python probes/probe_chaos.py
+
+Runs a REAL CredentialService over an 8-executor stub-device pool with a
+fast real-clock watchdog, then injects — via faults.ChaosSchedule-style
+mutable schedules on one FaultyBackend — ONE executor crash and ONE hung
+dispatch mid-run, and asserts the properties ISSUE 9 promises:
+
+  - every submitted future settles (none dropped, none dangling), with
+    zero verdict errors, in every phase — before, during, and after the
+    faults;
+  - the culprit executors are quarantined (crash + watchdog-timeout paths
+    both fire: serve_executor_crashes >= 1, serve_watchdog_timeouts >= 1,
+    serve_quarantined >= 2);
+  - goodput RECOVERS: the post-fault phase delivers at least half the
+    pre-fault goodput (the pool re-admits probed executors instead of
+    bleeding capacity).
+
+Prints a one-line JSON report (phases + recovery ratio + health counters)
+for the CI log. Everything runs on the CPU in a few seconds; the hang is
+Event-released before drain so no thread outlives the probe.
+"""
+
+import json
+import os
+import sys
+import time
+from types import SimpleNamespace
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from coconut_tpu import metrics
+from coconut_tpu.faults import FaultyBackend
+from coconut_tpu.serve import CredentialService, run_loadgen
+from coconut_tpu.serve.health import HealthPolicy, Watchdog
+
+
+class StubPerCred:
+    """Stub device: verdict is the credential's own ok flag."""
+
+    def batch_verify(self, sigs, msgs, vk, params):
+        return [s.sigma_1 is not None and bool(s.ok) for s in sigs]
+
+
+def _cred(ok=True):
+    return SimpleNamespace(sigma_1=1, sigma_2=1, ok=ok)
+
+
+def _phase(svc, pool, duration_s):
+    report = run_loadgen(
+        svc,
+        pool,
+        duration_s=duration_s,
+        arrival="closed",
+        concurrency=8,
+        result_timeout=30.0,
+    )
+    # the contract under chaos: every accepted future SETTLED, correctly
+    assert report["dropped_futures"] == 0, report
+    assert report["errors"] == 0, report
+    assert report["verdict_mismatches"] == 0, report
+    settled = report["completed"]
+    accepted = report["submitted"] - report["rejected"] - report["shed"]
+    assert settled == accepted, report
+    assert report["completed"] > 0, report
+    return report
+
+
+def main():
+    metrics.reset()
+    fb = FaultyBackend(StubPerCred())
+    svc = CredentialService(
+        fb,
+        None,
+        None,
+        max_batch=4,
+        max_wait_ms=2.0,
+        max_depth=512,
+        devices=8,
+        # fast real-clock self-healing so the whole experiment fits in a
+        # few CI seconds: tight watchdog budgets, short cooldown, one
+        # probe closes the breaker
+        watchdog=Watchdog(
+            k=3.0, min_timeout_s=0.2, initial_timeout_s=0.5, max_timeout_s=1.0
+        ),
+        watchdog_interval_s=0.05,
+        health_policy=HealthPolicy(probe_after_s=0.3, probe_successes=1),
+    ).start()
+    pool = [(_cred(), [0], True), (_cred(ok=False), [1], False)]
+
+    before = _phase(svc, pool, 0.6)
+
+    # schedule one executor-loop crash and one hung dispatch at
+    # near-future dispatch indices (the schedule attributes are mutable —
+    # the single dispatch counter makes the injection deterministic in
+    # INDEX even though thread interleaving picks the executor)
+    fb.crash_on = frozenset({fb.dispatches + 2})
+    fb.hang_on = frozenset({fb.dispatches + 40})
+    during = _phase(svc, pool, 1.2)
+    assert fb.crashes == 1, fb.crashes
+    assert fb.hang_entered.wait(5.0), "hang injection never dispatched"
+    fb.hang_release.set()  # free the abandoned worker before measuring
+
+    # give the probation ladder one cooldown's room, then measure recovery
+    time.sleep(0.4)
+    after = _phase(svc, pool, 0.6)
+
+    assert svc.drain(timeout=30.0), "drain timed out"
+
+    crashes = metrics.get_count("serve_executor_crashes")
+    timeouts = metrics.get_count("serve_watchdog_timeouts")
+    quarantined = metrics.get_count("serve_quarantined")
+    recovered = metrics.get_count("serve_recovered")
+    redistributed = metrics.get_count("serve_redistributed_batches")
+    assert crashes >= 1, "executor crash was never contained"
+    assert timeouts >= 1, "the hung dispatch was never expired"
+    assert quarantined >= 2, "culprit executors were not quarantined"
+    assert redistributed >= 1, "no unsettled batch was redistributed"
+    ratio = after["goodput_per_s"] / max(before["goodput_per_s"], 1e-9)
+    assert ratio >= 0.5, (
+        "goodput did not recover: before %.1f/s after %.1f/s"
+        % (before["goodput_per_s"], after["goodput_per_s"])
+    )
+
+    print(
+        json.dumps(
+            {
+                "goodput_per_s": {
+                    "before": before["goodput_per_s"],
+                    "during": during["goodput_per_s"],
+                    "after": after["goodput_per_s"],
+                },
+                "recovery_ratio": round(ratio, 3),
+                "completed": {
+                    "before": before["completed"],
+                    "during": during["completed"],
+                    "after": after["completed"],
+                },
+                "executor_crashes": crashes,
+                "watchdog_timeouts": timeouts,
+                "quarantined": quarantined,
+                "recovered": recovered,
+                "redistributed_batches": redistributed,
+            },
+            sort_keys=True,
+        )
+    )
+    print("chaos probe: ok (recovery ratio %.2f)" % ratio)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -69,6 +69,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "chaos: self-healing pool suite (crash containment, hung-dispatch "
+        "watchdog, quarantine/probation ladder, brownout shedding, chaos "
+        "schedules), also run explicitly by ci.sh's chaos lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

@@ -828,3 +828,210 @@ def test_pack_debug_callback_records_under_jit(monkeypatch):
     jax.effects_barrier()
     with pytest.raises(AssertionError, match="pack bound 396"):
         limbs.pack_debug_check()
+
+
+# --- chaos injection: crash / hang / schedules (ISSUE 9) -------------------
+
+
+@pytest.mark.chaos
+def test_injected_crash_escapes_exception_handlers_deterministically():
+    """InjectedCrash deliberately subclasses BaseException: per-batch
+    `except Exception` containment must NOT catch it — that is what makes
+    it reach the executor loop's crash handler in serve tests."""
+    from coconut_tpu.faults import InjectedCrash
+
+    assert issubclass(InjectedCrash, BaseException)
+    assert not issubclass(InjectedCrash, Exception)
+    faulty = FaultyBackend(StubPerCred(), crash_on={1})
+    assert faulty.batch_verify([_cred()], [[0]], None, None) == [True]
+    with pytest.raises(InjectedCrash, match="injected executor crash #1"):
+        faulty.batch_verify([_cred()], [[0]], None, None)
+    assert faulty.batch_verify([_cred()], [[0]], None, None) == [True]
+    assert faulty.crashes == 1 and faulty.dispatches == 3
+
+
+@pytest.mark.chaos
+def test_hang_injection_releases_without_real_sleeps():
+    """A pre-released hang returns immediately (the deterministic-test
+    mode); hang_entered is the sync point a watchdog test coordinates
+    on."""
+    faulty = FaultyBackend(StubPerCred(), hang_on={0})
+    faulty.hang_release.set()  # pre-release: the wait falls through
+    assert faulty.batch_verify([_cred()], [[0]], None, None) == [True]
+    assert faulty.hangs == 1 and faulty.hang_entered.is_set()
+    # async seam: the hang sits INSIDE the finalizer (a hung readback)
+    faulty2 = FaultyBackend(StubAsync(), hang_on={0})
+    faulty2.hang_release.set()
+    fin = faulty2.batch_verify_async([_cred()], [[0]], None, None)
+    assert faulty2.hangs == 0  # dispatch returned; the hang is in fin
+    assert fin() == [True]
+    assert faulty2.hangs == 1
+
+
+@pytest.mark.chaos
+def test_chaos_schedule_is_deterministic_and_replayable():
+    """The same ChaosSchedule wrapped twice over the same inner backend
+    yields the SAME outcome sequence — chaos experiments replay exactly."""
+    from coconut_tpu.faults import ChaosSchedule, InjectedCrash
+
+    sched = ChaosSchedule(fault_on={0}, flip_on={1}, crash_on={2})
+
+    def outcomes():
+        fb = sched.wrap(StubPerCred())
+        out = []
+        for _ in range(4):
+            try:
+                out.append(fb.batch_verify([_cred()], [[0]], None, None))
+            except TransientBackendError:
+                out.append("fault")
+            except InjectedCrash:
+                out.append("crash")
+        return out
+
+    first, second = outcomes(), outcomes()
+    assert first == ["fault", [False], "crash", [True]]
+    assert second == first
+    assert len(sched.backends) == 2
+    assert sched.describe() == {
+        "crash_on": [2],
+        "hang_on": [],
+        "fault_on": [0],
+        "flip_on": [1],
+        "delay_on": [],
+        "delay_s": 0.0,
+    }
+
+
+@pytest.mark.chaos
+def test_chaos_schedule_release_hangs_frees_every_wrapped_backend():
+    from coconut_tpu.faults import ChaosSchedule
+
+    sched = ChaosSchedule(hang_on={0})
+    backends = [sched.wrap(StubPerCred()) for _ in range(3)]
+    sched.release_hangs()
+    for fb in backends:
+        assert fb.hang_release.is_set()
+        assert fb.batch_verify([_cred()], [[0]], None, None) == [True]
+
+
+# --- dead-letter / flight JSONL rotation (ISSUE 9 satellite) ----------------
+
+
+@pytest.mark.chaos
+def test_dead_letter_rotates_on_record_count(tmp_path):
+    path = str(tmp_path / "dead.jsonl")
+    log = DeadLetterLog(path, max_records=2, keep=2)
+    for i in range(5):
+        log.append(batch=i, credential=0, reason="r%d" % i)
+    # newest-first rotation chain: live file r4; .1 = r2,r3; .2 = r0,r1
+    assert [r["batch"] for r in DeadLetterLog.read(path)] == [4]
+    assert [r["batch"] for r in DeadLetterLog.read(path + ".1")] == [2, 3]
+    assert [r["batch"] for r in DeadLetterLog.read(path + ".2")] == [0, 1]
+    assert metrics.get_count("rotations") == 2
+
+
+@pytest.mark.chaos
+def test_dead_letter_rotates_on_size_and_drops_past_keep(tmp_path):
+    path = str(tmp_path / "dead.jsonl")
+    log = DeadLetterLog(path, max_bytes=1, keep=2)  # every append rotates
+    for i in range(4):
+        log.append(batch=i, credential=0, reason="big")
+    assert [r["batch"] for r in DeadLetterLog.read(path)] == [3]
+    assert [r["batch"] for r in DeadLetterLog.read(path + ".1")] == [2]
+    assert [r["batch"] for r in DeadLetterLog.read(path + ".2")] == [1]
+    import os
+
+    assert not os.path.exists(path + ".3")  # keep=2: oldest dropped
+
+
+@pytest.mark.chaos
+def test_rotate_if_needed_unit(tmp_path):
+    from coconut_tpu.obs.flight import rotate_if_needed
+
+    path = str(tmp_path / "x.jsonl")
+    assert rotate_if_needed(path, max_bytes=1) is False  # no file yet
+    with open(path, "w") as f:
+        f.write("line\n")
+    assert rotate_if_needed(path, max_bytes=10**6) is False  # under cap
+    assert rotate_if_needed(path, max_records=1, record_count=1) is True
+    assert open(path + ".1").read() == "line\n"
+    import os
+
+    assert not os.path.exists(path)
+    assert metrics.get_count("rotations") == 1
+
+
+# --- crash-atomic checkpoint writes (ISSUE 9 satellite) ---------------------
+
+
+@pytest.mark.chaos
+def test_stale_torn_tmp_never_quarantines_the_checkpoint(tmp_path):
+    """A kill mid-save leaves at most a torn `<path>.tmp`; the restart
+    must load the intact checkpoint (or start clean) with ZERO
+    `.corrupt*` quarantines — the torn bytes never reach `path`."""
+    import os
+
+    path = _run_then_state(tmp_path, n=3)
+    doc_before = open(path).read()
+    with open(path + ".tmp", "w") as f:
+        f.write('{"schema": 2, "crc32": 123, "payl')  # torn mid-write
+    st = StreamState(path)
+    assert st.next_batch == 3 and st.quarantined is None
+    assert metrics.get_count("checkpoint_quarantined") == 0
+    assert open(path).read() == doc_before
+    # the next save truncates the stale tmp and lands atomically
+    st.save()
+    assert not os.path.exists(path + ".tmp")
+    assert not [p for p in os.listdir(tmp_path) if ".corrupt" in p]
+    assert StreamState(path).next_batch == 3
+
+
+@pytest.mark.chaos
+def test_save_failure_mid_replace_leaves_old_checkpoint_intact(
+    tmp_path, monkeypatch
+):
+    """If the atomic rename itself dies, `path` still holds the previous
+    COMPLETE document — a torn new document can never land there."""
+    import coconut_tpu.stream as stream_mod
+
+    path = _run_then_state(tmp_path, n=3)
+    before = open(path).read()
+    st = StreamState(path)
+    st.verified += 100
+
+    def boom(src, dst):
+        raise OSError("disk pulled mid-rename")
+
+    monkeypatch.setattr(stream_mod.os, "replace", boom)
+    with pytest.raises(OSError):
+        st.save()
+    monkeypatch.undo()
+    assert open(path).read() == before
+    reloaded = StreamState(path)
+    assert reloaded.quarantined is None and reloaded.next_batch == 3
+
+
+@pytest.mark.chaos
+def test_save_fsyncs_before_the_rename(tmp_path, monkeypatch):
+    """Ordering matters: the tmp file's bytes must be durable BEFORE the
+    rename makes them the checkpoint (else a power cut can leave a
+    complete-looking but empty file)."""
+    import coconut_tpu.stream as stream_mod
+
+    calls = []
+    real_fsync, real_replace = stream_mod.os.fsync, stream_mod.os.replace
+    monkeypatch.setattr(
+        stream_mod.os,
+        "fsync",
+        lambda fd: (calls.append("fsync"), real_fsync(fd))[1],
+    )
+    monkeypatch.setattr(
+        stream_mod.os,
+        "replace",
+        lambda s, d: (calls.append("replace"), real_replace(s, d))[1],
+    )
+    path = str(tmp_path / "state.json")
+    st = StreamState(path)
+    st.next_batch = 1
+    st.save()
+    assert calls.index("fsync") < calls.index("replace")

@@ -1,0 +1,271 @@
+"""Self-healing policy units (ISSUE 9): the per-executor circuit breaker
+(ExecutorHealth), the hung-dispatch Watchdog, and the BrownoutPolicy.
+
+These are the DECISION objects serve/service.py composes; each is driven
+here with a fake clock and zero threads — every transition, deadline, and
+shedding decision is a pure function of advanced time. The integration
+(abandon/respawn/redistribute against a live pool) lives in
+tests/test_serve.py's chaos section.
+"""
+
+import pytest
+
+from coconut_tpu import metrics
+from coconut_tpu.errors import ServiceBrownoutError
+from coconut_tpu.serve.health import (
+    HEALTHY,
+    PROBATION,
+    QUARANTINED,
+    SUSPECT,
+    BrownoutPolicy,
+    ExecutorHealth,
+    HealthPolicy,
+    Watchdog,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _health(clock, **kw):
+    kw.setdefault("suspect_after", 1)
+    kw.setdefault("quarantine_after", 3)
+    kw.setdefault("probe_after_s", 5.0)
+    kw.setdefault("probe_successes", 2)
+    return ExecutorHealth("0", HealthPolicy(**kw), clock=clock)
+
+
+# --- ExecutorHealth: the breaker ladder ------------------------------------
+
+
+def test_policy_validates_knobs():
+    with pytest.raises(ValueError):
+        HealthPolicy(suspect_after=0)
+    with pytest.raises(ValueError):
+        HealthPolicy(suspect_after=3, quarantine_after=2)
+    with pytest.raises(ValueError):
+        HealthPolicy(probe_successes=0)
+
+
+def test_failures_escalate_suspect_then_quarantine():
+    h = _health(FakeClock())
+    assert h.state == HEALTHY and h.admissible()
+    assert h.on_failure("f1") == (HEALTHY, SUSPECT)
+    assert h.state == SUSPECT and h.admissible()  # warning shot: still placed
+    assert h.on_failure("f2") is None  # 2 < quarantine_after
+    assert h.on_failure("f3") == (SUSPECT, QUARANTINED)
+    assert not h.admissible()
+    assert metrics.get_count("serve_quarantined") == 1
+    assert metrics.get_gauge("serve_dev0_health") == QUARANTINED
+    # further failures while quarantined are no-ops, not re-opens
+    assert h.on_failure("f4") is None
+    assert metrics.get_count("serve_quarantined") == 1
+
+
+def test_success_resets_the_failure_count_and_clears_suspect():
+    h = _health(FakeClock())
+    h.on_failure()
+    assert h.state == SUSPECT
+    assert h.on_success() == (SUSPECT, HEALTHY)
+    # the consecutive count reset with it: two more failures don't open
+    h.on_failure()
+    h.on_failure()
+    assert h.state == SUSPECT
+    h.on_success()
+    assert h.state == HEALTHY and h.consecutive_failures == 0
+
+
+def test_crash_quarantines_immediately_whatever_the_count():
+    h = _health(FakeClock())
+    assert h.on_crash("boom") == (HEALTHY, QUARANTINED)
+    assert h.quarantines == 1 and not h.admissible()
+
+
+def test_probation_ladder_closes_after_consecutive_probe_successes():
+    clock = FakeClock()
+    h = _health(clock, probe_successes=2)
+    h.on_crash("boom")
+    # cooldown not elapsed: stays quarantined
+    assert not h.try_probation()
+    clock.advance(5.0)
+    assert h.try_probation()
+    assert h.state == PROBATION and h.admissible()
+    assert h.on_success() is None  # 1 of 2
+    assert h.on_success() == (PROBATION, HEALTHY)
+    assert metrics.get_count("serve_recovered") == 1
+    assert metrics.get_gauge("serve_dev0_health") == HEALTHY
+
+
+def test_failed_probe_requarantines_with_escalated_cooldown():
+    clock = FakeClock()
+    h = _health(clock, probe_after_s=5.0, cooldown_backoff=2.0)
+    h.on_crash("boom")
+    assert h.cooldown_s == 5.0
+    clock.advance(5.0)
+    assert h.try_probation()
+    assert h.on_failure("probe died") == (PROBATION, QUARANTINED)
+    assert metrics.get_count("serve_probe_failures") == 1
+    assert h.cooldown_s == 10.0  # backed off
+    clock.advance(5.0)
+    assert not h.try_probation()  # old cooldown no longer enough
+    clock.advance(5.0)
+    assert h.try_probation()
+    # crash DURING probation escalates the same way
+    h.on_crash("probe crashed")
+    assert h.cooldown_s == 20.0
+    assert metrics.get_count("serve_probe_failures") == 2
+
+
+def test_cooldown_escalation_is_bounded_and_recovery_deescalates():
+    clock = FakeClock()
+    h = _health(
+        clock, probe_after_s=5.0, cooldown_backoff=10.0, max_cooldown_s=30.0,
+        probe_successes=1,
+    )
+    h.on_crash("boom")
+    for _ in range(3):  # 5 -> 30 (capped), stays 30
+        clock.advance(100.0)
+        assert h.try_probation()
+        h.on_failure("still bad")
+    assert h.cooldown_s == 30.0
+    clock.advance(100.0)
+    assert h.try_probation()
+    h.on_success()  # breaker closes...
+    assert h.state == HEALTHY
+    assert h.cooldown_s == 5.0  # ...and the NEXT incident starts from base
+
+
+# --- Watchdog: deadline budgets + expiry -----------------------------------
+
+
+def test_watchdog_budget_initial_then_k_times_ema_clamped():
+    clock = FakeClock()
+    wd = Watchdog(
+        clock=clock, k=4.0, min_timeout_s=1.0, initial_timeout_s=100.0,
+        max_timeout_s=50.0, alpha=0.5,
+    )
+    assert wd.budget("0") == 100.0  # no EMA yet: don't shoot the jit compile
+    wd.begin("0", 0, ["r"])
+    clock.advance(2.0)
+    assert wd.end("0", 0) == 2.0
+    assert wd.ema("0") == 2.0
+    assert wd.budget("0") == 8.0  # k * ema
+    # EMA converges: alpha * new + (1 - alpha) * prev
+    wd.begin("0", 1, ["r"])
+    clock.advance(4.0)
+    wd.end("0", 1)
+    assert wd.ema("0") == pytest.approx(3.0)
+    # clamping: a tiny EMA floors at min, a huge one caps at max
+    wd._ema["0"] = 0.01
+    assert wd.budget("0") == 1.0
+    wd._ema["0"] = 1000.0
+    assert wd.budget("0") == 50.0
+
+
+def test_watchdog_expire_pops_each_hang_exactly_once():
+    clock = FakeClock()
+    wd = Watchdog(clock=clock, initial_timeout_s=10.0)
+    reqs = ["the batch"]
+    wd.begin("0", 7, reqs, span=None)
+    wd.begin("1", 8, ["fine"])
+    clock.advance(5.0)
+    assert wd.expire() == []
+    clock.advance(5.0)  # dispatch 7 and 8 both hit their deadline at t=10
+    expired = wd.expire()
+    assert {(e[0], e[1]) for e in expired} == {("0", 7), ("1", 8)}
+    lbl, seq, got, span, overdue = [e for e in expired if e[0] == "0"][0]
+    assert got is reqs and overdue == 0.0 and span is None
+    assert wd.expire() == []  # popped: fires exactly once
+    assert wd.inflight() == 0
+
+
+def test_watchdog_late_end_after_expiry_never_pollutes_the_ema():
+    clock = FakeClock()
+    wd = Watchdog(clock=clock, initial_timeout_s=1.0)
+    wd.begin("0", 0, ["r"])
+    clock.advance(2.0)
+    assert len(wd.expire()) == 1
+    # the hung dispatch finally returns, hours later
+    clock.advance(7200.0)
+    assert wd.end("0", 0) is None
+    assert wd.ema("0") is None
+    # failed settles don't feed the EMA either
+    wd.begin("0", 1, ["r"])
+    clock.advance(0.5)
+    assert wd.end("0", 1, ok=False) is None
+    assert wd.ema("0") is None
+
+
+def test_watchdog_forget_label_drops_a_crashed_executors_tracking():
+    clock = FakeClock()
+    wd = Watchdog(clock=clock, initial_timeout_s=1.0)
+    wd.begin("0", 0, ["a"])
+    wd.begin("0", 1, ["b"])
+    wd.begin("1", 2, ["c"])
+    assert wd.forget_label("0") == 2
+    clock.advance(5.0)
+    assert [e[0] for e in wd.expire()] == ["1"]
+
+
+def test_watchdog_validates_knobs():
+    with pytest.raises(ValueError):
+        Watchdog(k=0)
+    with pytest.raises(ValueError):
+        Watchdog(alpha=1.5)
+
+
+# --- BrownoutPolicy: graded load-shedding ----------------------------------
+
+
+def test_brownout_sheds_bulk_on_degraded_capacity_keeps_interactive():
+    bp = BrownoutPolicy(capacity_threshold=0.5, retry_after_s=0.5)
+    # healthy pool, idle queue: inactive for everyone
+    assert bp.check("bulk", 0, 100, 1.0) == (False, None)
+    # half the pool quarantined: 0.5 is NOT below the threshold yet
+    assert bp.check("bulk", 0, 100, 0.5) == (False, None)
+    # below it: bulk sheds, interactive rides through
+    active, hint = bp.check("bulk", 0, 100, 0.25)
+    assert active and hint is not None and hint > bp.retry_after_s
+    active, hint = bp.check("interactive", 0, 100, 0.25)
+    assert active and hint is None
+
+
+def test_brownout_sheds_bulk_on_queue_depth_pressure():
+    bp = BrownoutPolicy(depth_threshold=0.75, retry_after_s=0.5)
+    assert bp.check("bulk", 74, 100, 1.0) == (False, None)
+    active, hint = bp.check("bulk", 75, 100, 1.0)
+    assert active and hint == pytest.approx(0.5 * 1.75)
+    # the hint scales with pressure: a fuller queue asks for a longer wait
+    _, worse = bp.check("bulk", 100, 100, 1.0)
+    assert worse > hint
+
+
+def test_brownout_error_is_typed_and_carries_the_hint():
+    err = ServiceBrownoutError("bulk", 0.875, depth=75, capacity_fraction=0.25)
+    assert err.lane == "bulk" and err.retry_after_s == 0.875
+    assert err.depth == 75 and err.capacity_fraction == 0.25
+    assert "retry" in str(err) and "bulk" in str(err)
+
+
+def test_brownout_validates_knobs():
+    with pytest.raises(ValueError):
+        BrownoutPolicy(capacity_threshold=1.5)
+    with pytest.raises(ValueError):
+        BrownoutPolicy(depth_threshold=0.0)

@@ -785,3 +785,215 @@ def test_mesh_serve_integration_sharded_routing_correct_bits():
     snap = metrics.snapshot()
     assert snap["counters"]["serve_devmesh_dispatches"] == 2
     assert "serve_devmesh_busy_s" in snap["timers_s"]
+
+
+# --- self-healing pool (ISSUE 9): crash / hang / quarantine / brownout -----
+
+
+def _chaos_service(backend, clock, **kw):
+    """A pool service wired for deterministic chaos: fake clock, a
+    fake-clock watchdog with a 1s initial budget, and NO watchdog thread —
+    the test drives health_tick() by hand after advancing time."""
+    from coconut_tpu.serve.health import HealthPolicy, Watchdog
+
+    kw.setdefault("devices", 2)
+    kw.setdefault("max_batch", 2)
+    kw.setdefault(
+        "watchdog",
+        Watchdog(clock=clock, k=2.0, min_timeout_s=1.0, initial_timeout_s=1.0),
+    )
+    kw.setdefault("watchdog_interval_s", None)
+    kw.setdefault(
+        "health_policy", HealthPolicy(probe_after_s=5.0, probe_successes=1)
+    )
+    return _service(backend, clock=clock, **kw)
+
+
+@pytest.mark.chaos
+def test_watchdog_timeout_quarantines_and_redistributes_the_hung_batch():
+    """A sync dispatch that never returns is invisible to the retry
+    ladder; the watchdog expires it on a FAKE clock, abandons the stuck
+    worker, quarantines the executor, and the batch settles on the
+    survivor — no real sleeps, every future resolves."""
+    from coconut_tpu.serve.health import QUARANTINED
+
+    clock = FakeClock()
+    be = FaultyBackend(StubPerCred(), hang_on={0})
+    svc = _chaos_service(be, clock)
+    futs = [svc.submit(_cred(), [i]) for i in range(2)]  # one full batch
+    svc.start()
+    try:
+        # device 0's worker is now wedged INSIDE the dispatch
+        assert be.hang_entered.wait(5.0), "hang injection never reached"
+        clock.advance(2.0)  # past the 1s initial watchdog budget
+        svc.health_tick()
+        # the hung batch was redistributed to device 1 and settles there
+        assert [f.result(10.0) for f in futs] == [True, True]
+        assert metrics.get_count("serve_watchdog_timeouts") == 1
+        assert metrics.get_count("serve_quarantined") == 1
+        assert metrics.get_count("serve_redistributed_batches") == 1
+        assert metrics.get_count("serve_redistributed_requests") == 2
+        assert metrics.get_gauge("serve_dev0_health") == QUARANTINED
+        assert metrics.get_gauge("serve_healthy_executors") == 1
+    finally:
+        be.hang_release.set()  # un-wedge the abandoned worker
+    assert svc.drain(timeout=10.0)
+    # the late return of the timed-out dispatch was discarded (stale
+    # settle), not double-delivered — and nothing else ever hung
+    assert metrics.get_count("serve_failed_requests") == 0
+
+
+@pytest.mark.chaos
+def test_executor_crash_contained_to_one_device():
+    """An executor-loop crash (an InjectedCrash BaseException escaping the
+    per-batch containment) quarantines ONLY its executor; its batch
+    settles on the survivor and the pool keeps serving."""
+    from coconut_tpu.faults import InjectedCrash
+    from coconut_tpu.serve.health import HEALTHY, QUARANTINED
+
+    clock = FakeClock()
+    be = FaultyBackend(StubPerCred(), crash_on={0})
+    svc = _chaos_service(be, clock)
+    futs = [svc.submit(_cred(), [i]) for i in range(2)]
+    svc.start()
+    assert [f.result(10.0) for f in futs] == [True, True]
+    assert be.crashes == 1
+    assert metrics.get_count("serve_executor_crashes") == 1
+    assert metrics.get_count("serve_quarantined") == 1
+    assert metrics.get_count("serve_redistributed_batches") == 1
+    assert metrics.get_gauge("serve_dev0_health") == QUARANTINED
+    assert metrics.get_gauge("serve_dev1_health") == HEALTHY
+    # the pool is degraded, not dead: new work still settles
+    futs2 = [svc.submit(_cred(), [i]) for i in range(2)]
+    assert [f.result(10.0) for f in futs2] == [True, True]
+    assert svc.drain(timeout=10.0)
+    assert isinstance(svc._crashed, type(None)) and not isinstance(
+        svc._crashed, InjectedCrash
+    )
+
+
+@pytest.mark.chaos
+def test_quarantine_probation_recovery_ladder_readmits_the_executor():
+    """The full ladder: crash -> QUARANTINED -> (cooldown on the fake
+    clock) -> PROBATION with a respawned worker -> one successful probe
+    batch -> HEALTHY again."""
+    from coconut_tpu.serve.health import HEALTHY, PROBATION, QUARANTINED
+
+    clock = FakeClock()
+    be = FaultyBackend(StubPerCred(), crash_on={0})
+    svc = _chaos_service(be, clock)
+    futs = [svc.submit(_cred(), [i]) for i in range(2)]
+    svc.start()
+    assert [f.result(10.0) for f in futs] == [True, True]
+    assert metrics.get_gauge("serve_dev0_health") == QUARANTINED
+    assert not svc._executors[0].has_worker()  # abandoned
+    # cooldown not elapsed: the tick changes nothing
+    clock.advance(1.0)
+    svc.health_tick()
+    assert metrics.get_gauge("serve_dev0_health") == QUARANTINED
+    # cooldown elapsed: half-open probe window, fresh worker spawned
+    clock.advance(5.0)
+    svc.health_tick()
+    assert metrics.get_gauge("serve_dev0_health") == PROBATION
+    assert svc._executors[0].has_worker()
+    # next batch is the probe: load-tie placement picks device 0 first
+    probe = [svc.submit(_cred(), [i]) for i in range(2)]
+    assert [f.result(10.0) for f in probe] == [True, True]
+    assert metrics.get_count("serve_probes") >= 1
+    assert metrics.get_count("serve_recovered") == 1
+    assert metrics.get_gauge("serve_dev0_health") == HEALTHY
+    assert metrics.get_gauge("serve_healthy_executors") == 2
+    assert svc.drain(timeout=10.0)
+
+
+@pytest.mark.chaos
+def test_all_executors_dead_poisons_service_with_no_dangling_futures():
+    """Crash containment's floor: when EVERY executor has died, the
+    service poisons — each accepted future resolves with the crash
+    exception (none dangle) and new submissions are refused, typed."""
+    from coconut_tpu.faults import InjectedCrash
+
+    clock = FakeClock()
+    be = FaultyBackend(StubPerCred(), crash_on=set(range(16)))
+    svc = _chaos_service(be, clock)
+    futs = [svc.submit(_cred(), [i]) for i in range(2)]
+    svc.start()
+    for f in futs:
+        assert isinstance(f.exception(10.0), InjectedCrash)
+    assert svc._crashed is not None
+    with pytest.raises(ServiceClosedError):
+        svc.submit(_cred(), [0])
+    assert metrics.get_count("serve_executor_crashes") == 2
+    assert svc.drain(timeout=10.0)
+
+
+@pytest.mark.chaos
+def test_redispatch_hop_cap_fails_a_poisonous_batch_loudly():
+    """A batch whose dispatch crashes every executor it lands on fails ITS
+    OWN futures after max_redispatch hops instead of serially killing the
+    whole pool: device 2 survives."""
+    from coconut_tpu.faults import InjectedCrash
+    from coconut_tpu.serve.health import HEALTHY
+
+    clock = FakeClock()
+    be = FaultyBackend(StubPerCred(), crash_on={0, 1})
+    svc = _chaos_service(be, clock, devices=3, max_redispatch=1)
+    futs = [svc.submit(_cred(), [i]) for i in range(2)]
+    svc.start()
+    for f in futs:
+        assert isinstance(f.exception(10.0), InjectedCrash)
+    assert metrics.get_count("serve_redispatch_exhausted") == 1
+    assert svc._crashed is None  # the SERVICE survived the poison batch
+    assert metrics.get_gauge("serve_dev2_health") == HEALTHY
+    futs2 = [svc.submit(_cred(), [i]) for i in range(2)]
+    assert [f.result(10.0) for f in futs2] == [True, True]
+    assert svc.drain(timeout=10.0)
+
+
+@pytest.mark.chaos
+def test_brownout_sheds_bulk_admits_interactive():
+    """With half the pool quarantined (below a 0.9 capacity threshold),
+    bulk submissions shed with the typed retriable error + hint while
+    interactive requests ride through and resolve."""
+    from coconut_tpu.errors import ServiceBrownoutError
+    from coconut_tpu.serve.health import BrownoutPolicy
+
+    clock = FakeClock()
+    svc = _chaos_service(
+        StubPerCred(),
+        clock,
+        brownout=BrownoutPolicy(capacity_threshold=0.9, retry_after_s=0.25),
+    )
+    svc._health_of("0").on_crash("injected for the brownout test")
+    with pytest.raises(ServiceBrownoutError) as ei:
+        svc.submit(_cred(), [0], lane="bulk")
+    assert ei.value.retry_after_s > 0 and ei.value.lane == "bulk"
+    assert ei.value.capacity_fraction == 0.5
+    assert metrics.get_count("serve_shed_bulk") == 1
+    assert metrics.get_gauge("serve_brownout") == 1
+    # interactive stays live: admitted, dispatched on the survivor
+    svc.start()
+    futs = [svc.submit(_cred(), [i]) for i in range(2)]
+    assert [f.result(10.0) for f in futs] == [True, True]
+    assert metrics.get_count("serve_dev1_dispatches") == 1
+    assert metrics.get_count("serve_dev0_dispatches") == 0
+    assert svc.drain(timeout=10.0)
+
+
+@pytest.mark.chaos
+def test_drain_timeout_is_one_shared_deadline_not_per_thread():
+    """drain(timeout=0.5) against four executors all wedged in a gated
+    dispatch returns False in ~one timeout's worth of wall clock — the old
+    per-thread join semantics would have taken >= 4x. A later drain after
+    the gate opens still settles everything."""
+    be = GatedPerCred()
+    svc = _service(be, max_batch=1, devices=4).start()
+    futs = [svc.submit(_cred(), [i]) for i in range(4)]
+    assert be.entered.wait(5.0)
+    t0 = time.monotonic()
+    assert svc.drain(timeout=0.5) is False
+    elapsed = time.monotonic() - t0
+    assert elapsed < 1.5, elapsed  # shared deadline, not 4 x 0.5s of joins
+    be.release.set()
+    assert svc.drain(timeout=10.0) is True
+    assert [f.result(0) for f in futs] == [True] * 4
