@@ -20,6 +20,7 @@ Also measured (BASELINE.md configs):
   config 4: threshold issuance, batched blind-sign MSMs            [default]
   config 5: short streamed run through verify_stream               [BENCH_STREAM=1]
   serve lane: loadgen against the online CredentialService         [--serve]
+  issue lane: loadgen against the online IssuanceService           [--issue]
 
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
@@ -44,6 +45,16 @@ efficiency goodput_n/(n*goodput_1) — embedded under "serve"."scaling"
 (BENCH_SERVE_SWEEP_SECONDS trims the per-point duration; on the jax
 backend each executor pins to a real device, elsewhere executors are
 unpinned workers).
+
+Issue lane (`python bench.py --issue`): pure-issuance closed-loop loadgen
+(issue_fraction=1.0) against a real BENCH_ISSUE_AUTHORITIES-of-
+BENCH_ISSUE_THRESHOLD (default 5, t=3) IssuanceService — quorum fan-out,
+first-t-of-n aggregation, verify-before-release on the hot path —
+embedding credentials/sec, quorum-wait p50/p95/p99, hedge rate, and mint
+outcome counts under "issue". Knobs: BENCH_ISSUE_SECONDS (default 2),
+BENCH_ISSUE_MAX_BATCH (default 4), BENCH_ISSUE_CONCURRENCY (default
+2*max_batch); BENCH_ISSUE=0 skips (the same gate as the offline config-4
+blind-sign lane); composes with --serve and BENCH_OFFLINE=0.
 
 Chaos-recovery sub-report (ISSUE 9, on by default with --serve;
 BENCH_CHAOS=0 skips): a three-phase loadgen pass — clean, then one
@@ -203,6 +214,100 @@ def bench_serve(ge, params, vk, sigs, msgs_list, extras, backend_name):
             params, vk, pool, backend_name, mode, max_batch, max_wait_ms
         )
     return report["goodput_per_s"]
+
+
+def bench_issue(ge, params, vk, sigs, msgs_list, extras, backend_name):
+    """Threshold-issuance lane (--issue): pure-issuance closed-loop
+    loadgen (issue_fraction=1.0) against a REAL t-of-n IssuanceService —
+    quorum fan-out, first-t-of-n aggregation, verify-before-release all
+    on the hot path. Embeds credentials/sec, quorum-wait p50/p95/p99,
+    and the hedge rate under extras["issue"]; returns the goodput
+    (credentials/sec). BENCH_ISSUE=0 skips (same gate as the offline
+    config-4 blind-sign lane)."""
+    from coconut_tpu import metrics
+    from coconut_tpu.elgamal import elgamal_keygen
+    from coconut_tpu.issue import IssuanceService
+    from coconut_tpu.keygen import trusted_party_SSS_keygen
+    from coconut_tpu.serve import CredentialService, run_loadgen
+    from coconut_tpu.signature import SignatureRequest
+    from coconut_tpu.sss import rand_fr
+
+    seconds = float(os.environ.get("BENCH_ISSUE_SECONDS", "2"))
+    max_batch = int(os.environ.get("BENCH_ISSUE_MAX_BATCH", "4"))
+    concurrency = int(
+        os.environ.get("BENCH_ISSUE_CONCURRENCY", str(2 * max_batch))
+    )
+    total = int(os.environ.get("BENCH_ISSUE_AUTHORITIES", "5"))
+    threshold = int(os.environ.get("BENCH_ISSUE_THRESHOLD", "3"))
+
+    _, _, signers = trusted_party_SSS_keygen(threshold, total, params)
+    ipool = []
+    for _ in range(4 * max_batch):
+        msgs = [rand_fr() for _ in range(ge.MSG_COUNT)]
+        esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+        req, _ = SignatureRequest.new(msgs, 2, epk, params)
+        ipool.append((req, msgs, esk))
+
+    isvc = IssuanceService(
+        signers, params, threshold, backend=backend_name,
+        max_batch=max_batch,
+    )
+    # the mixed-workload loadgen drives a verify service too; at
+    # issue_fraction=1.0 it sits idle but must exist and be started
+    vsvc = CredentialService(
+        backend_name, vk, params, max_batch=max_batch
+    )
+    with vsvc, isvc:
+        # warm every authority at the serving shape OUTSIDE the timed
+        # window (on the jax backend the first sign pays compile time)
+        warm = [
+            isvc.submit(*ipool[i % len(ipool)]) for i in range(max_batch)
+        ]
+        for f in warm:
+            f.result(timeout=600.0)
+        report = run_loadgen(
+            vsvc,
+            [(sigs[0], msgs_list[0], True)],
+            duration_s=seconds,
+            arrival="closed",
+            concurrency=concurrency,
+            issue_service=isvc,
+            issue_pool=ipool,
+            issue_fraction=1.0,
+        )
+    issue = report["issue"]
+    assert issue["dropped_futures"] == 0, (
+        "issue lane dropped futures: %r" % (issue,)
+    )
+    assert issue["mint_mismatches"] == 0, (
+        "issue lane released a falsy mint: %r" % (issue,)
+    )
+    assert issue["errors"] == 0, "issue lane errors: %r" % (issue,)
+    assert issue["minted"] > 0, "issue lane minted nothing: %r" % (issue,)
+    qwait = (
+        metrics.snapshot()
+        .get("histograms", {})
+        .get("issue_quorum_wait_s", {})
+    )
+    extras["issue"] = {
+        "authorities": total,
+        "threshold": threshold,
+        "max_batch": max_batch,
+        "concurrency": concurrency,
+        **issue,
+        "credentials_per_sec": issue["goodput_per_s"],
+        "quorum_wait_s": {
+            "p50": qwait.get("p50_s"),
+            "p95": qwait.get("p95_s"),
+            "p99": qwait.get("p99_s"),
+        },
+        "hedge_rate": (
+            round(issue["hedges"] / issue["fanouts"], 4)
+            if issue["fanouts"]
+            else None
+        ),
+    }
+    return issue["goodput_per_s"]
 
 
 def _bench_chaos_recovery(params, vk, pool, backend_name, mode, max_batch,
@@ -398,9 +503,17 @@ def main():
     reps = int(os.environ.get("BENCH_REPS", "5"))
     backend_name = os.environ.get("BENCH_BACKEND", "jax")
     serve_flag = "--serve" in sys.argv[1:]
-    # BENCH_OFFLINE=0 (only meaningful with --serve) skips the offline
-    # lanes so the CI serve smoke doesn't pay for them
-    offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not serve_flag
+    # the online issuance lane shares the offline config-4 gate: if the
+    # operator turned blind-sign benching off, the CLI flag stays off too
+    issue_flag = (
+        "--issue" in sys.argv[1:]
+        and os.environ.get("BENCH_ISSUE", "1") == "1"
+    )
+    # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
+    # offline lanes so the CI online smokes don't pay for them
+    offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
+        serve_flag or issue_flag
+    )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import __graft_entry__ as ge
@@ -438,6 +551,14 @@ def main():
         if value is None:
             value = goodput
             metric, unit = "serve_goodput_per_sec", "requests/sec"
+
+    if issue_flag:
+        minted_per_s = bench_issue(
+            ge, params, vk, sigs, msgs_list, extras, backend_name
+        )
+        if value is None:
+            value = minted_per_s
+            metric, unit = "issue_credentials_per_sec", "credentials/sec"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

@@ -148,6 +148,39 @@ print("chaos bench smoke: ok (recovery ratio %.2f, %d quarantined, "
                                  cr["counters"]["serve_watchdog_timeouts"]))
 PYEOF
 
+echo "== issue lane (threshold issuance: quorum fan-out / hedging / attribution) =="
+# the marker suite: fake-clock quorum/hedge/attribution mechanics plus the
+# real-crypto first-t-bit-identical and crash+hang acceptance tests
+python -m pytest tests/ -m issue -q
+# end-to-end acceptance smoke (ISSUE 10): a real 5-authority t=3 pool
+# takes one injected authority crash AND one hung sign on its first
+# fan-out; the probe asserts every order minted, every minted credential
+# verifies under the Lagrange-aggregated verkey, and the crashed
+# authority was quarantined while the pool kept minting
+JAX_PLATFORMS=cpu python probes/probe_issue.py
+# issuance bench smoke: pure-issuance loadgen against the real service on
+# the CPU backend, asserted from the JSON artifact a human reads
+ISSUE_JSON=$(mktemp -d)/issue.json
+BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 \
+  BENCH_ISSUE_SECONDS=1.5 BENCH_ISSUE_MAX_BATCH=4 JAX_PLATFORMS=cpu \
+  python bench.py --issue > "$ISSUE_JSON"
+ISSUE_JSON_PATH="$ISSUE_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["ISSUE_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["issue"]
+assert report["dropped_futures"] == 0, report
+assert report["mint_mismatches"] == 0, report
+assert report["errors"] == 0, report
+assert report["minted"] > 0, report
+assert report["quorum_unreachable"] == 0, report
+assert report["quorum_wait_s"]["p95"] is not None, report
+print("issue smoke: ok (%.1f credentials/s, quorum-wait p95 %.0f ms, "
+      "hedge rate %s)" % (report["credentials_per_sec"],
+                          report["quorum_wait_s"]["p95"] * 1000.0,
+                          report["hedge_rate"]))
+EOF
+
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q
 # end-to-end acceptance smoke on the REAL service (CPU, stub backend):

@@ -95,6 +95,28 @@ class ServiceBrownoutError(CoconutError):
         self.capacity_fraction = capacity_fraction
 
 
+class QuorumUnreachableError(CoconutError):
+    """The threshold-issuance layer cannot assemble t distinct valid
+    partial signatures for a request: too many authorities are crashed,
+    hung, quarantined, or emitting corrupt partials (coconut_tpu/issue/).
+    RETRIABLE by design — quorum loss is usually transient (authorities
+    re-admit through the probation ladder; a hedged retry may land on a
+    healthier pool). Carries `needed` (the threshold t), `have` (distinct
+    valid partials collected), and `live` (authorities that could still
+    contribute when the service gave up). Counted under
+    "issue_quorum_unreachable"."""
+
+    def __init__(self, needed, have, live=0):
+        super().__init__(
+            "issuance quorum unreachable: have %d of %d required partial "
+            "signatures with only %d live authorities left able to "
+            "contribute — retry once the pool recovers" % (have, needed, live)
+        )
+        self.needed = needed
+        self.have = have
+        self.live = live
+
+
 class ServiceClosedError(CoconutError):
     """A request was submitted to (or was still queued in) a credential
     service that is draining or shut down (serve/service.py). Futures of

@@ -128,6 +128,30 @@ class FaultyBackend:
     dispatch) — the probe/bench chaos phases schedule faults relative to
     the live dispatch counter this way.
 
+    SIGN-PATH seams (threshold issuance, coconut_tpu/issue/): the
+    authority executors dispatch `batch_blind_sign` THROUGH the backend
+    object when it exposes one, and this wrapper always does — so the
+    same harness drives issuance chaos. Sign dispatches tick their OWN
+    0-based counter (`sign_dispatches`), independent of the verify
+    counter, so a chaos schedule addresses "the 3rd sign" without
+    counting verify traffic:
+
+      fail_sign_on    — sign dispatch indices that raise `error` before
+                        the inner signer runs (a transient authority
+                        fault: the quorum layer hedges around it);
+      crash_sign_on   — sign dispatch indices that raise `InjectedCrash`
+                        (BaseException: crashes the AUTHORITY loop — the
+                        quarantine + hedge-coverage path);
+      hang_sign_on    — sign dispatch indices that block on the shared
+                        `hang_release` event (a wedged authority: only
+                        the issue watchdog frees its fan-out);
+      corrupt_partial_on — sign dispatch indices whose FIRST partial
+                        signature comes back with one limb flipped
+                        (c_tilde_2 displaced by h): a Byzantine
+                        authority emitting a plausible-but-invalid
+                        share — the verify-before-release gate must
+                        catch and attribute it.
+
     `error` is the exception class raised (default TransientBackendError;
     pass e.g. RuntimeError to model a permanent fault)."""
 
@@ -146,6 +170,10 @@ class FaultyBackend:
         hang_on=(),
         hang_release=None,
         hang_max_s=30.0,
+        fail_sign_on=(),
+        crash_sign_on=(),
+        hang_sign_on=(),
+        corrupt_partial_on=(),
         sleep=time.sleep,
         error=TransientBackendError,
     ):
@@ -165,15 +193,26 @@ class FaultyBackend:
         )
         self.hang_entered = threading.Event()
         self.hang_max_s = hang_max_s
+        self.fail_sign_on = frozenset(fail_sign_on)
+        self.crash_sign_on = frozenset(crash_sign_on)
+        self.hang_sign_on = frozenset(hang_sign_on)
+        self.corrupt_partial_on = frozenset(corrupt_partial_on)
         self.hangs = 0
         self.crashes = 0
+        self.corrupted_partials = 0
         self.sleep = sleep
         self.error = error
         self.dispatches = 0
+        self.sign_dispatches = 0
 
     def _tick(self):
         idx = self.dispatches
         self.dispatches += 1
+        return idx
+
+    def _sign_tick(self):
+        idx = self.sign_dispatches
+        self.sign_dispatches += 1
         return idx
 
     def _dispatch_faulted(self, idx):
@@ -217,6 +256,50 @@ class FaultyBackend:
                 return [not b for b in result]
             return not result
         return result
+
+    def batch_blind_sign(self, sig_requests, sigkey, params):
+        """The authority-side sign seam (coconut_tpu/issue/authority.py
+        dispatches through the backend's `batch_blind_sign` when it has
+        one — this wrapper always does, so wrapping an authority's backend
+        puts its sign path under the chaos schedules). Ticks the SEPARATE
+        sign-dispatch counter; delegates to the inner backend's own
+        `batch_blind_sign` when present, else to the library entry point
+        with the inner backend's MSM primitives."""
+        idx = self._sign_tick()
+        if idx in self.crash_sign_on:
+            self.crashes += 1
+            raise InjectedCrash(
+                "injected authority crash on sign dispatch #%d" % idx
+            )
+        if idx in self.fail_sign_on:
+            raise self.error("injected sign-dispatch fault #%d" % idx)
+        if idx in self.hang_sign_on:
+            self.hangs += 1
+            self.hang_entered.set()
+            self.hang_release.wait(self.hang_max_s)
+        inner_sign = getattr(self.inner, "batch_blind_sign", None)
+        if inner_sign is not None:
+            out = inner_sign(sig_requests, sigkey, params)
+        else:
+            from .signature import batch_blind_sign as _bbs
+
+            out = _bbs(sig_requests, sigkey, params, backend=self.inner)
+        if idx in self.corrupt_partial_on and out:
+            # flip ONE limb of ONE partial: displace the first partial's
+            # c_tilde_2 by its own h — still a valid curve point (the
+            # plausible Byzantine case), but the share no longer
+            # interpolates, so only verify-before-release can catch it
+            from .signature import BlindSignature
+
+            bs = out[0]
+            ops = params.ctx.sig
+            out = [
+                BlindSignature(
+                    bs.h, (bs.blinded[0], ops.add(bs.blinded[1], bs.h))
+                )
+            ] + list(out[1:])
+            self.corrupted_partials += 1
+        return out
 
     def __getattr__(self, name):
         attr = getattr(self.inner, name)
@@ -285,6 +368,10 @@ class ChaosSchedule:
         flip_on=(),
         delay_on=(),
         delay_s=0.0,
+        fail_sign_on=(),
+        crash_sign_on=(),
+        hang_sign_on=(),
+        corrupt_partial_on=(),
     ):
         self.crash_on = frozenset(crash_on)
         self.hang_on = frozenset(hang_on)
@@ -292,6 +379,10 @@ class ChaosSchedule:
         self.flip_on = frozenset(flip_on)
         self.delay_on = frozenset(delay_on)
         self.delay_s = delay_s
+        self.fail_sign_on = frozenset(fail_sign_on)
+        self.crash_sign_on = frozenset(crash_sign_on)
+        self.hang_sign_on = frozenset(hang_sign_on)
+        self.corrupt_partial_on = frozenset(corrupt_partial_on)
         self.backends = []
 
     def wrap(self, inner, **kwargs):
@@ -305,6 +396,10 @@ class ChaosSchedule:
             delay_s=self.delay_s,
             crash_on=self.crash_on,
             hang_on=self.hang_on,
+            fail_sign_on=self.fail_sign_on,
+            crash_sign_on=self.crash_sign_on,
+            hang_sign_on=self.hang_sign_on,
+            corrupt_partial_on=self.corrupt_partial_on,
             **kwargs,
         )
         self.backends.append(fb)
@@ -323,6 +418,10 @@ class ChaosSchedule:
             "flip_on": sorted(self.flip_on),
             "delay_on": sorted(self.delay_on),
             "delay_s": self.delay_s,
+            "fail_sign_on": sorted(self.fail_sign_on),
+            "crash_sign_on": sorted(self.crash_sign_on),
+            "hang_sign_on": sorted(self.hang_sign_on),
+            "corrupt_partial_on": sorted(self.corrupt_partial_on),
         }
 
 

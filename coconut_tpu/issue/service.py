@@ -1,0 +1,869 @@
+"""The threshold-issuance service: quorum fan-out over a pool of signing
+authorities, first-t-of-n aggregation, and straggler-hedged minting.
+
+Where serve/service.py answers "is this credential valid?" against ONE
+verkey, this service MINTS credentials against a t-of-n authority pool:
+each request's SignatureRequest is blind-signed by every live authority
+(quorum fan-out), the first t partial signatures to land are unblinded,
+Lagrange-aggregated, and verified under the subset's aggregated verkey,
+and only a credential that VERIFIES is released to its future.
+
+The pipeline reuses the serving stack wholesale rather than reinventing
+it — the same seams, parameterized to the "issue" metric namespace:
+
+  admission   serve/queue.RequestQueue  (bounded depth, lanes, futures,
+              spans born at admission; payload = issuance order)
+  coalescing  serve/batcher.Batcher     (full-batch or oldest-deadline
+              flush; the ready gate holds backlog until >= t authorities
+              can accept)
+  health      serve/health.ExecutorHealth per AUTHORITY (circuit breaker:
+              quarantine -> probation -> healthy), health.Watchdog for
+              hung sign dispatches, health.BrownoutPolicy for graded
+              shedding
+  tracing     obs spans: request/queue_wait at admission, an
+              "issue_batch" root per fan-out with unblind/aggregate/
+              verify children on the mint path
+
+What is NEW here versus the verify pool (issue/ package):
+
+  QUORUM FAN-OUT (quorum.QuorumTracker): one coalesced batch goes to ALL
+  live authorities at once; the batch resolves when the FIRST t distinct
+  partial rows land. The slowest n-t authorities are off the latency
+  path — redundancy is the latency strategy, not just the fault
+  strategy. Late rows (stragglers, hedge losers, abandoned workers) hit
+  a stale guard and are discarded, mirroring PR 9's stale-settle.
+
+  PER-PARTIAL PROVENANCE: every partial row is filed under its
+  authority's signer id. When a minted credential fails verification,
+  each contributing partial is re-verified under ITS authority's own
+  verkey — the culprit is named exactly, fed to that authority's circuit
+  breaker (quarantine after the policy's threshold), its rows dropped,
+  and the mint retried from the next usable subset. A corrupt authority
+  costs a mint round, never a corrupt credential: the release gate is
+  verification under the aggregated verkey.
+
+  STRAGGLER HEDGING (hedge.HedgePolicy/HedgeScheduler): when one
+  authority's sign outlives k x its own latency EMA, the batch is
+  dispatched to a SPARE authority; first-t-wins picks the winner and the
+  loser's row is discarded stale. The hedge k is deliberately smaller
+  than the watchdog's — hedge early (costs one duplicate dispatch),
+  quarantine late (condemns the authority).
+
+Failure ladder, per fan-out: a sign FAULT (exception) marks the target
+failed and re-covers from spares; a sign HANG is expired by the watchdog
+(worker abandoned, authority quarantined, coverage restored); an
+authority-loop CRASH quarantines only that authority. When live + landed
+contributors can no longer reach t, the fan-out's remaining futures fail
+with the typed, retriable QuorumUnreachableError — loud, attributable,
+and never a dangling future. Drain settles everything in flight under
+one shared deadline and sweeps whatever could not reach quorum.
+"""
+
+import threading
+import time
+
+from .. import metrics
+from ..errors import (
+    GeneralError,
+    QuorumUnreachableError,
+    ServiceBrownoutError,
+    ServiceClosedError,
+)
+from ..obs import trace as otrace
+from ..serve import health as _health
+from ..serve.batcher import Batcher, fail_all
+from ..serve.queue import RequestQueue
+from .authority import SigningAuthority
+from .hedge import HedgePolicy, HedgeScheduler
+from .quorum import CryptoMinter, Fanout, QuorumTracker
+
+
+def _remaining(deadline):
+    """Seconds left until `deadline` on the REAL clock (thread joins are
+    wall-time waits even under an injected fake clock); None = no bound."""
+    if deadline is None:
+        return None
+    return max(0.0, deadline - time.monotonic())
+
+
+class IssuanceOrder:
+    """One request's issuance payload, carried in the queue Request's
+    `sig` slot (the queue is payload-agnostic): the blind-sign request
+    plus the user's ElGamal secret the service unblinds with."""
+
+    __slots__ = ("sig_request", "elgamal_sk")
+
+    def __init__(self, sig_request, elgamal_sk):
+        self.sig_request = sig_request
+        self.elgamal_sk = elgamal_sk
+
+
+class IssuanceService:
+    """Dynamic-batching threshold-issuance service over a signer pool.
+
+    signers: keygen.Signer list (id, sigkey share, per-signer verkey) —
+    the authority pool; threshold: t, the quorum size. backend: default
+    backend (instance or name) for every authority AND the minter;
+    backends: optional per-authority override list aligned with signers
+    (chaos tests wrap ONE authority's backend in faults.FaultyBackend
+    without touching the others); devices: optional per-authority jax
+    device list (device-pinned sign dispatch). minter: the resolution
+    crypto (default quorum.CryptoMinter; tests inject a stub to exercise
+    quorum mechanics fake-clock, crypto-free).
+
+    Self-healing knobs mirror serve/service.py: health_policy per-
+    authority breaker, watchdog for hung signs, watchdog_interval_s the
+    health-tick period (None = tests drive health_tick() by hand),
+    brownout for graded shedding, hedge a hedge.HedgePolicy (None
+    disables hedging)."""
+
+    def __init__(
+        self,
+        signers,
+        params,
+        threshold,
+        backend=None,
+        backends=None,
+        devices=None,
+        minter=None,
+        max_batch=32,
+        max_wait_ms=20.0,
+        max_depth=1024,
+        clock=time.monotonic,
+        health_policy=None,
+        watchdog=None,
+        watchdog_interval_s=0.25,
+        hedge=None,
+        brownout=None,
+    ):
+        signers = list(signers)
+        if not signers:
+            raise ValueError("need at least one signer")
+        if threshold < 1 or threshold > len(signers):
+            raise ValueError(
+                "threshold %r out of range for %d signers"
+                % (threshold, len(signers))
+            )
+        if backends is not None and len(backends) != len(signers):
+            raise ValueError(
+                "backends list length %d != %d signers"
+                % (len(backends), len(signers))
+            )
+        if devices is not None and len(devices) != len(signers):
+            raise ValueError(
+                "devices list length %d != %d signers"
+                % (len(devices), len(signers))
+            )
+        self.params = params
+        self.threshold = threshold
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.clock = clock
+        self._authorities = [
+            SigningAuthority(
+                self,
+                s,
+                backend=(backends[i] if backends is not None else backend),
+                device=(devices[i] if devices is not None else None),
+            )
+            for i, s in enumerate(signers)
+        ]
+        self.minter = (
+            minter
+            if minter is not None
+            else CryptoMinter(
+                threshold,
+                {s.id: s.verkey for s in signers},
+                params,
+                backend=backend,
+            )
+        )
+        self._queue = RequestQueue(
+            max_depth=max_depth, clock=clock, metric_ns="issue"
+        )
+        self._batcher = Batcher(self._queue, max_batch, clock=clock)
+        self._tracker = QuorumTracker(threshold, clock=clock)
+        self.hedge_policy = hedge if hedge is not None else HedgePolicy()
+        self._hedges = HedgeScheduler(clock=clock)
+        self._thread = None
+        self._seq_lock = threading.Lock()
+        self._fanout_seq = 0
+        #: dispatch bookkeeping lock: Fanout.targets / Fanout.failed and
+        #: spare-selection decisions (quorum-arrival state is under the
+        #: tracker's own lock; never take _flock while holding it)
+        self._flock = threading.Lock()
+        self._crashed = None
+
+        self.health_policy = (
+            health_policy if health_policy is not None else _health.HealthPolicy()
+        )
+        self._watchdog = (
+            watchdog if watchdog is not None else _health.Watchdog(clock=clock)
+        )
+        self._watchdog_interval_s = watchdog_interval_s
+        self._brownout = (
+            brownout if brownout is not None else _health.BrownoutPolicy()
+        )
+        self._healths = {}
+        for auth in self._authorities:
+            self._health_of(auth.label)
+        self._wd_stop = threading.Event()
+        self._wd_thread = None
+        for auth in self._authorities:
+            metrics.set_gauge(
+                "issue_auth%s_health" % auth.label, _health.HEALTHY
+            )
+        self._refresh_health_gauges()
+
+    # -- client side ---------------------------------------------------------
+
+    def submit(
+        self, sig_request, messages, elgamal_sk, lane="interactive",
+        max_wait_ms=None,
+    ):
+        """Admit one issuance request; returns a ServeFuture resolving to
+        the minted (verified, aggregated) Signature. `messages` is the
+        FULL message vector (hidden + known — the verification gate needs
+        it; the authorities only ever see `sig_request`). Raises
+        ServiceBrownoutError / ServiceOverloadedError / ServiceClosedError
+        exactly like the verify service."""
+        if self._crashed is not None:
+            raise ServiceClosedError(
+                "issuance service crashed: %r" % (self._crashed,)
+            )
+        depth = self._queue.depth()
+        capacity = self._capacity_fraction()
+        active, retry_after = self._brownout.check(
+            lane, depth, self._queue.max_depth, capacity
+        )
+        metrics.set_gauge("issue_brownout", 1 if active else 0)
+        if retry_after is not None:
+            metrics.count("issue_shed_bulk")
+            raise ServiceBrownoutError(
+                lane, retry_after, depth=depth, capacity_fraction=capacity
+            )
+        return self._queue.submit(
+            IssuanceOrder(sig_request, elgamal_sk),
+            messages,
+            lane=lane,
+            max_wait_ms=(
+                self.max_wait_ms if max_wait_ms is None else max_wait_ms
+            ),
+        )
+
+    def depth(self):
+        return self._queue.depth()
+
+    def kick(self):
+        """Wake the placer to re-read the clock (fake-clock tests)."""
+        self._queue.kick()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self):
+        if self._thread is None:
+            for auth in self._authorities:
+                auth.start()
+            self._thread = threading.Thread(
+                target=self._run, name="coconut-issue", daemon=True
+            )
+            self._thread.start()
+            if self._watchdog_interval_s is not None:
+                self._wd_thread = threading.Thread(
+                    target=self._watchdog_loop,
+                    name="coconut-issue-watchdog",
+                    daemon=True,
+                )
+                self._wd_thread.start()
+        return self
+
+    def drain(self, timeout=None):
+        """Close intake, settle every accepted request, join the pool.
+        Every accepted future is resolved on return: minted, failed
+        typed, or — for fan-outs that could not reach quorum before the
+        shared deadline — failed with QuorumUnreachableError."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        self._queue.close()
+        ok = True
+        if self._thread is None:
+            fail_all(
+                self._queue.drain_pending(),
+                ServiceClosedError("service drained before start()"),
+                counter="issue_cancelled",
+            )
+        else:
+            self._thread.join(_remaining(deadline))
+            ok = not self._thread.is_alive()
+        for auth in self._authorities:
+            auth.close()
+        for auth in self._authorities:
+            ok = auth.join(_remaining(deadline)) and ok
+        self._sweep_unreachable()
+        return self._stop_watchdog(deadline) and ok
+
+    def shutdown(self, drain=True, timeout=None):
+        """drain=False refuses the queued backlog (ServiceClosedError)
+        but still settles fan-outs already dispatched."""
+        if drain:
+            return self.drain(timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        self._queue.close()
+        fail_all(
+            self._queue.drain_pending(),
+            ServiceClosedError("service shut down before this request ran"),
+            counter="issue_cancelled",
+        )
+        ok = True
+        if self._thread is not None:
+            self._thread.join(_remaining(deadline))
+            ok = not self._thread.is_alive()
+        for auth in self._authorities:
+            auth.close()
+        for auth in self._authorities:
+            ok = auth.join(_remaining(deadline)) and ok
+        self._sweep_unreachable()
+        return self._stop_watchdog(deadline) and ok
+
+    def _stop_watchdog(self, deadline):
+        thread = self._wd_thread
+        if thread is None:
+            return True
+        self._wd_stop.set()
+        thread.join(_remaining(deadline))
+        return not thread.is_alive()
+
+    def _sweep_unreachable(self):
+        """Drain's last act: any fan-out still open could not assemble a
+        quorum in time — fail its unresolved futures loudly (typed,
+        retriable) so no caller ever hangs on a dropped future."""
+        for f in self._tracker.outstanding():
+            with self._flock:
+                have = len(f.available_ids())
+            pending = [i for i in f.pending if not f.requests[i].future.done()]
+            if pending:
+                metrics.count("issue_quorum_unreachable")
+                self._fail_requests(
+                    f,
+                    pending,
+                    QuorumUnreachableError(self.threshold, have, live=0),
+                )
+            self._close_fanout(f, result="swept")
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.drain()
+        return False
+
+    # -- health --------------------------------------------------------------
+
+    def _health_of(self, label):
+        h = self._healths.get(label)
+        if h is None:
+            h = self._healths[label] = _health.ExecutorHealth(
+                label,
+                self.health_policy,
+                clock=self.clock,
+                metric_ns="issue",
+                gauge_prefix="issue_auth",
+            )
+        return h
+
+    def _admits(self, auth):
+        """May NEW fan-out work target `auth`? Same half-open discipline
+        as the verify pool: PROBATION gets one probe dispatch at a time."""
+        h = self._health_of(auth.label)
+        if not h.admissible():
+            return False
+        if h.state == _health.PROBATION and auth.queued() > 0:
+            return False
+        return True
+
+    def _capacity_fraction(self):
+        ok = sum(
+            1
+            for a in self._authorities
+            if self._health_of(a.label).admissible()
+        )
+        return ok / len(self._authorities)
+
+    def _refresh_health_gauges(self):
+        metrics.set_gauge(
+            "issue_healthy_authorities",
+            sum(
+                1
+                for a in self._authorities
+                if self._health_of(a.label).admissible()
+            ),
+        )
+
+    def _note_success(self, auth):
+        change = self._health_of(auth.label).on_success()
+        if change:
+            self._refresh_health_gauges()
+            self._queue.kick()
+
+    def _note_failure(self, auth, reason):
+        """A sign dispatch (or a partial-signature attribution) failed ON
+        this authority: feed its breaker; on quarantine, move its queued
+        fan-outs' coverage to spares (soft — the worker stays alive)."""
+        change = self._health_of(auth.label).on_failure(reason)
+        if change:
+            self._refresh_health_gauges()
+            self._queue.kick()
+            if change[1] == _health.QUARANTINED:
+                for f in auth.sweep_inbox():
+                    self._mark_failed(f, auth.label)
+                    self._ensure_coverage(f)
+
+    def _authority_failed(self, auth, exc, inflight, gen):
+        """Authority-loop crash containment (runs ON the dying worker's
+        thread): quarantine ONLY this authority, re-cover its fan-outs
+        from spares. Stale generations (already abandoned by the
+        watchdog) do nothing."""
+        if not auth.is_current(gen):
+            return
+        metrics.count("issue_authority_crashes")
+        self._health_of(auth.label).on_crash(
+            "authority loop crash: %s" % type(exc).__name__
+        )
+        swept = auth.abandon()
+        self._watchdog.forget_label(auth.label)
+        self._refresh_health_gauges()
+        affected = ([inflight] if inflight is not None else []) + swept
+        for f in affected:
+            self._mark_failed(f, auth.label)
+            self._ensure_coverage(f)
+        self._queue.kick()
+
+    def health_tick(self, now=None):
+        """One self-healing sweep: expire hung signs (abandon the stuck
+        worker, quarantine its authority, restore quorum coverage), fire
+        due hedges (dispatch a spare for each straggling sign), and
+        promote cooled-down authorities into probation. Runs on the
+        watchdog thread in production; fake-clock tests call it directly."""
+        if self._crashed is not None:
+            return
+        now = self.clock() if now is None else now
+        for label, fid, fanout, span, overdue_s in self._watchdog.expire(now):
+            metrics.count("issue_watchdog_timeouts")
+            if span is not None:
+                span.event(
+                    "watchdog_timeout",
+                    authority=label,
+                    overdue_s=round(overdue_s, 6),
+                )
+            auth = self._auth_by_label(label)
+            if auth is None:
+                continue
+            self._health_of(label).on_crash("hung sign: watchdog timeout")
+            swept = auth.abandon()
+            self._watchdog.forget_label(label)
+            self._refresh_health_gauges()
+            self._hedges.end(fid, label)
+            for f in [fanout] + swept:
+                self._mark_failed(f, label)
+                self._ensure_coverage(f)
+            self._queue.kick()
+        for fanout, label, overdue_s in self._hedges.due(now):
+            if fanout.resolved:
+                continue
+            spare = self._pick_spare(fanout)
+            if spare is None:
+                metrics.count("issue_hedge_no_spare")
+                continue
+            metrics.count("issue_hedges")
+            fanout.bspan.event(
+                "hedge",
+                straggler=label,
+                spare=spare.label,
+                overdue_s=round(overdue_s, 6),
+            )
+            self._dispatch_to(fanout, spare, now=now)
+        for auth in self._authorities:
+            if self._health_of(auth.label).try_probation(now):
+                auth.start()  # respawn an abandoned worker; no-op otherwise
+                self._refresh_health_gauges()
+                self._queue.kick()
+
+    def _watchdog_loop(self):
+        while not self._wd_stop.wait(self._watchdog_interval_s):
+            try:
+                self.health_tick()
+            except Exception:
+                metrics.count("issue_health_tick_errors")
+
+    def _auth_by_label(self, label):
+        for a in self._authorities:
+            if a.label == label:
+                return a
+        return None
+
+    # -- fan-out -------------------------------------------------------------
+
+    def _has_quorum_capacity(self):
+        """ready() gate for the batcher: pop a batch only when at least
+        `threshold` admissible authorities can accept it — otherwise the
+        backlog stays in the bounded queue where admission control and
+        the brownout policy see it."""
+        return (
+            sum(
+                1
+                for a in self._authorities
+                if self._admits(a) and a.can_accept()
+            )
+            >= self.threshold
+        )
+
+    def _fan_out(self, requests):
+        """Open one fan-out for a coalesced batch and dispatch it to
+        every live authority at once (first-t-wins makes over-dispatch
+        the latency strategy)."""
+        with self._seq_lock:
+            fid = self._fanout_seq
+            self._fanout_seq += 1
+        now = self.clock()
+        targets = [
+            a for a in self._authorities if self._admits(a) and a.can_accept()
+        ]
+        if len(targets) < self.threshold:
+            # the ready gate normally prevents this; a drain-time flush
+            # (closed queue bypasses the gate) widens to anything alive
+            targets = [
+                a
+                for a in self._authorities
+                if self._health_of(a.label).admissible() or a.has_worker()
+            ]
+        if len(targets) < self.threshold:
+            metrics.count("issue_quorum_unreachable")
+            fail_all(
+                requests,
+                QuorumUnreachableError(self.threshold, 0, live=len(targets)),
+                counter="issue_failed_requests",
+            )
+            return
+        bspan = otrace.start_span(
+            "issue_batch",
+            root=True,
+            seq=fid,
+            n=len(requests),
+            quorum=self.threshold,
+            fanout_width=len(targets),
+            members=[r.future.trace_id for r in requests]
+            if otrace.enabled()
+            else None,
+        )
+        for r in requests:
+            r.span.set(batch_trace=bspan.trace_id, batch_seq=fid)
+        f = Fanout(
+            fid,
+            requests,
+            [r.sig.sig_request for r in requests],
+            [r.messages for r in requests],
+            [r.sig.elgamal_sk for r in requests],
+            bspan,
+            now,
+        )
+        self._tracker.open(f)
+        metrics.observe(
+            "issue_batch_wait_s", now - min(r.t_submit for r in requests)
+        )
+        metrics.set_gauge("issue_queue_depth", self._queue.depth())
+        for auth in targets:
+            self._dispatch_to(f, auth, now=now)
+
+    def _dispatch_to(self, fanout, auth, now=None):
+        """Dispatch one fan-out to one authority: deadline-track the sign
+        (watchdog from BEFORE the dispatch — a hung sign never returns),
+        arm its hedge timer, enqueue."""
+        now = self.clock() if now is None else now
+        with self._flock:
+            if fanout.resolved or auth.label in fanout.targets:
+                return False
+            fanout.targets[auth.label] = auth
+        if self._health_of(auth.label).state == _health.PROBATION:
+            metrics.count("issue_probes")
+        self._watchdog.begin(
+            auth.label, fanout.fid, fanout, span=fanout.bspan, now=now
+        )
+        self._hedges.begin(
+            fanout, auth.label, self.hedge_policy.budget(auth.label), now=now
+        )
+        auth.submit(fanout)
+        return True
+
+    def _mark_failed(self, fanout, label):
+        with self._flock:
+            fanout.failed.add(label)
+        self._hedges.end(fanout.fid, label)
+
+    def _pick_spare(self, fanout):
+        """An admissible authority this fan-out has not targeted yet (and
+        whose rows were not attributed corrupt), least-queued first."""
+        with self._flock:
+            targeted = set(fanout.targets)
+        spares = [
+            a
+            for a in self._authorities
+            if a.label not in targeted
+            and a.id not in fanout.dropped
+            and self._admits(a)
+            and a.has_worker()
+        ]
+        if not spares:
+            return None
+        return min(spares, key=lambda a: (a.queued(), a.id))
+
+    def _ensure_coverage(self, fanout):
+        """Re-check that landed + still-signing contributors can reach t;
+        dispatch spares to close any gap ("issue_redispatched"), and when
+        no spare can close it, fail the fan-out's unresolved requests
+        with the typed, retriable QuorumUnreachableError."""
+        while True:
+            if fanout.resolved:
+                return
+            with self._flock:
+                have = len(fanout.available_ids())
+                inflight = sum(
+                    1
+                    for label, a in fanout.targets.items()
+                    if label not in fanout.failed
+                    and a.id not in fanout.partials
+                    and a.id not in fanout.dropped
+                )
+            if have + inflight >= self.threshold:
+                return
+            spare = self._pick_spare(fanout)
+            if spare is None:
+                break
+            if self._dispatch_to(fanout, spare):
+                metrics.count("issue_redispatched")
+        pending = [
+            i for i in fanout.pending if not fanout.requests[i].future.done()
+        ]
+        if not pending:
+            return
+        with self._flock:
+            have = len(fanout.available_ids())
+        metrics.count("issue_quorum_unreachable")
+        self._fail_requests(
+            fanout,
+            pending,
+            QuorumUnreachableError(self.threshold, have, live=have),
+        )
+        if self._tracker.settle(fanout, pending):
+            self._close_fanout(fanout, result="unreachable")
+
+    # -- sign + mint (run on authority threads) ------------------------------
+
+    def _sign_fanout(self, auth, fanout, gen):
+        """One authority's turn on one fan-out: sign the coalesced batch
+        under its share, file the row, and — on the call that completes
+        the quorum — mint."""
+        if fanout.resolved:
+            # first-t-wins already resolved this fan-out (cancel raced
+            # the pop): skip the sign, settle the trackers
+            metrics.count("issue_sign_skips")
+            self._watchdog.end(auth.label, fanout.fid, now=self.clock())
+            self._hedges.end(fanout.fid, auth.label)
+            return
+        t0 = self.clock()
+        try:
+            with metrics.timer(auth.busy_timer):
+                partials = auth.sign(fanout.sig_reqs, self.params)
+        except Exception as e:
+            # sign FAULT (not a crash — the worker survives): mark this
+            # target failed, breaker the authority, restore coverage
+            self._watchdog.end(
+                auth.label, fanout.fid, ok=False, now=self.clock()
+            )
+            self._mark_failed(fanout, auth.label)
+            self._note_failure(
+                auth, "sign dispatch failed: %s" % type(e).__name__
+            )
+            self._ensure_coverage(fanout)
+            return
+        now = self.clock()
+        if not auth.is_current(gen):
+            # stale worker: the watchdog expired this sign and the
+            # fan-out was re-covered — the late row is nobody's news
+            metrics.count("issue_partials_discarded", len(partials))
+            return
+        self._watchdog.end(auth.label, fanout.fid, now=now)
+        self._hedges.end(fanout.fid, auth.label)
+        self.hedge_policy.observe(auth.label, now - t0)
+        self._note_success(auth)
+        subset = self._tracker.record(fanout, auth.id, partials, now=now)
+        while subset is not None:
+            subset = self._mint(fanout, subset)
+
+    def _mint(self, fanout, subset):
+        """One mint round over `subset` (the caller holds the tracker's
+        minting claim): unblind -> batch-aggregate -> verify under the
+        aggregated verkey. Passing lanes release; failing lanes trigger
+        per-partial attribution, the culprit's rows drop, and the round
+        retries from the next subset (returned; None = done or waiting
+        for more rows)."""
+        indices = sorted(fanout.pending)
+        if not indices:
+            self._tracker.settle(fanout, [])
+            self._close_fanout(fanout, result="minted")
+            return None
+        blind_rows = [
+            [fanout.partials[i][idx] for i in subset] for idx in indices
+        ]
+        sks = [fanout.sks[idx] for idx in indices]
+        messages_list = [fanout.messages_list[idx] for idx in indices]
+        try:
+            with otrace.use(fanout.bspan):
+                with otrace.span("unblind", n=len(indices), t=len(subset)):
+                    sig_rows = self.minter.unblind(blind_rows, sks)
+                with otrace.span("aggregate", subset=list(subset)):
+                    creds = self.minter.aggregate(subset, sig_rows)
+                with otrace.span("verify", n=len(indices)):
+                    verdicts = self.minter.verify(
+                        creds, messages_list, subset
+                    )
+        except Exception as e:
+            # the mint crypto itself failed (malformed subset row, code
+            # bug): fail THIS fan-out's unresolved lanes loudly — the
+            # authorities are fine, the partials were not
+            metrics.count("issue_mint_failures")
+            self._fail_requests(fanout, indices, e)
+            if self._tracker.settle(fanout, indices):
+                self._close_fanout(fanout, result="mint_failed")
+            return None
+        ok_idx = [i for i, v in zip(indices, verdicts) if v]
+        bad_pos = [p for p, v in enumerate(verdicts) if not v]
+        if ok_idx:
+            self._release(
+                fanout,
+                ok_idx,
+                {
+                    idx: cred
+                    for idx, cred, v in zip(indices, creds, verdicts)
+                    if v
+                },
+            )
+        if not bad_pos:
+            if self._tracker.settle(fanout, ok_idx):
+                self._close_fanout(fanout, result="minted")
+                return None
+            return self._tracker.next_subset(fanout)
+        if ok_idx:
+            self._tracker.settle(fanout, ok_idx)
+        # ATTRIBUTION: an aggregated credential failed verification, so
+        # at least one contributing partial is corrupt — re-verify each
+        # failing lane's partials under their authorities' OWN verkeys
+        # to name the culprits exactly (per-partial provenance)
+        culprits = set()
+        for p in bad_pos:
+            row = sig_rows[p]
+            msgs = messages_list[p]
+            for j, signer_id in enumerate(subset):
+                if signer_id in culprits:
+                    continue
+                if not self.minter.verify_partial(signer_id, row[j], msgs):
+                    culprits.add(signer_id)
+        if not culprits:
+            # every partial checks out yet the aggregate does not: the
+            # REQUEST itself is unservable (e.g. inconsistent messages
+            # vs its own commitment) — fail just those lanes, typed
+            bad_idx = [indices[p] for p in bad_pos]
+            metrics.count("issue_mint_failures")
+            self._fail_requests(
+                fanout,
+                bad_idx,
+                GeneralError(
+                    "minted credential failed verification with no "
+                    "attributable corrupt partial — request unservable"
+                ),
+            )
+            if self._tracker.settle(fanout, bad_idx):
+                self._close_fanout(fanout, result="mint_failed")
+                return None
+            return self._tracker.next_subset(fanout)
+        metrics.count("issue_corrupt_partials", len(culprits))
+        fanout.bspan.event("corrupt_partials", authorities=sorted(culprits))
+        self._tracker.drop_partials(fanout, culprits)
+        for signer_id in culprits:
+            auth = next(
+                (a for a in self._authorities if a.id == signer_id), None
+            )
+            if auth is not None:
+                self._note_failure(auth, "corrupt partial signature")
+        subset = self._tracker.next_subset(fanout)
+        if subset is None:
+            # not enough clean rows yet: the minting claim was released;
+            # make sure enough contributors are still coming
+            self._ensure_coverage(fanout)
+        return subset
+
+    def _release(self, fanout, indices, creds_by_idx):
+        """Hand verified credentials to their futures — the ONLY path a
+        credential leaves the service on, and it is behind the verify
+        gate by construction."""
+        now = self.clock()
+        for idx in indices:
+            r = fanout.requests[idx]
+            metrics.observe("issue_latency_s", now - r.t_submit)
+            r.span.end(verdict=True)
+            r.future.set_result(creds_by_idx[idx])
+        metrics.count("issue_minted", len(indices))
+
+    def _fail_requests(self, fanout, indices, exc):
+        for idx in indices:
+            r = fanout.requests[idx]
+            r.queue_span.end()
+            r.span.end(error=type(exc).__name__)
+            r.future.set_exception(exc)
+        if indices:
+            metrics.count("issue_failed_requests", len(indices))
+
+    def _close_fanout(self, fanout, result):
+        """Fully settled (or force-failed): close the record everywhere —
+        tracker (marks resolved: late rows discard), hedge timers, every
+        authority's queued copy (a canceled queued sign ends its watchdog
+        deadline too; one mid-sign finishes and ends its own)."""
+        self._tracker.close_fanout(fanout)
+        self._hedges.cancel(fanout.fid)
+        now = self.clock()
+        for auth in self._authorities:
+            if auth.cancel(fanout.fid):
+                self._watchdog.end(auth.label, fanout.fid, now=now)
+                metrics.count("issue_cancelled_signs")
+        fanout.bspan.end(result=result)
+
+    # -- placer --------------------------------------------------------------
+
+    def _crash(self, e):
+        """Placer crash: sweep every queued and open future with the
+        crash exception — no caller ever hangs."""
+        self._crashed = e
+        self._queue.close()
+        fail_all(
+            self._queue.drain_pending(), e, counter="issue_failed_requests"
+        )
+        for f in self._tracker.outstanding():
+            pending = [
+                i for i in f.pending if not f.requests[i].future.done()
+            ]
+            if pending:
+                self._fail_requests(f, pending, e)
+            self._close_fanout(f, result="crashed")
+        for auth in self._authorities:
+            auth.close()
+
+    def _run(self):
+        try:
+            while True:
+                batch = self._batcher.next_batch(
+                    block=True, ready=self._has_quorum_capacity
+                )
+                if batch is None:
+                    return
+                self._fan_out(batch)
+        except BaseException as e:
+            self._crash(e)
+            raise

@@ -56,6 +56,32 @@ and "rotations" / "rotation_errors" (dead-letter/flight JSONL rotation).
 Gauges: "serve_dev<d>_health" (the state string), "serve_healthy_executors"
 (admissible pool size), "serve_brownout" (0/1 shed-mode flag).
 
+The THRESHOLD-ISSUANCE service (coconut_tpu/issue/) reports under the
+"issue" namespace — the same queue/batcher/health machinery re-namespaced
+("issue_admitted" / "issue_rejected" / "issue_batches" /
+"issue_batched_requests" / "issue_shed_bulk", per-authority
+"issue_auth<a>_dispatches" / "issue_auth<a>_busy_s", breaker counters
+"issue_quarantined" / "issue_probes" / "issue_probe_failures" /
+"issue_recovered", "issue_watchdog_timeouts", "issue_authority_crashes",
+"issue_health_tick_errors") plus the quorum-specific surfaces:
+"issue_minted" (credentials released — each verified under the
+aggregated verkey before release), "issue_hedges" (straggler hedge
+dispatches fired) / "issue_hedge_no_spare" (hedges that found no spare
+authority), "issue_partials_discarded" (late/duplicate/stale partial
+rows dropped by the first-t-wins guard), "issue_corrupt_partials"
+(partial rows attributed to a corrupt authority by per-partial
+verification), "issue_redispatched" (coverage re-dispatches to spare
+authorities), "issue_cancelled_signs" (queued signs canceled after the
+quorum resolved), "issue_sign_skips" (popped signs skipped because the
+fan-out had already resolved), "issue_quorum_unreachable" (fan-outs
+failed with QuorumUnreachableError), "issue_mint_failures" /
+"issue_failed_requests" / "issue_cancelled" (failure outcomes).
+Histograms: "issue_quorum_wait_s" (dispatch -> t-th partial, the quorum
+assembly latency), "issue_latency_s" (admission -> release, the
+client-facing SLO), "issue_batch_wait_s" (coalescing delay). Gauges:
+"issue_auth<a>_health", "issue_healthy_authorities",
+"issue_queue_depth", "issue_brownout".
+
 THREAD SAFETY: the serving layer is the first multi-threaded writer
 (admission happens on client threads while the supervisor thread settles
 batches), so every mutation and `snapshot()` runs under one module lock —

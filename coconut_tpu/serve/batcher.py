@@ -64,12 +64,19 @@ class Batcher:
     is ready to flush yet (the supervisor uses this to settle in-flight
     work instead of idling)."""
 
-    def __init__(self, queue, max_batch, clock=time.monotonic):
+    def __init__(self, queue, max_batch, clock=time.monotonic, metric_ns=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1 (got %r)" % (max_batch,))
         self.queue = queue
         self.max_batch = max_batch
         self.clock = clock
+        # counter namespace: follow the queue's unless overridden, so the
+        # issuance service's coalescing reports under "issue_*"
+        self.metric_ns = (
+            metric_ns
+            if metric_ns is not None
+            else getattr(queue, "metric_ns", "serve")
+        )
 
     def _ready_locked(self):
         """(flush_now, wait_s): whether a batch should flush immediately,
@@ -107,8 +114,10 @@ class Batcher:
                     flush, wait_s = self._ready_locked()
                     if flush:
                         batch = q._pop_locked(self.max_batch)
-                        metrics.count("serve_batches")
-                        metrics.count("serve_batched_requests", len(batch))
+                        metrics.count("%s_batches" % self.metric_ns)
+                        metrics.count(
+                            "%s_batched_requests" % self.metric_ns, len(batch)
+                        )
                         for req in batch:
                             # queue_wait ends the moment the request is IN
                             # a coalesced batch — its dur is the admission->

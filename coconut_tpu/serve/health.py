@@ -112,10 +112,24 @@ class ExecutorHealth:
     """One executor's breaker state. Thread-safe: settles report from
     executor threads while the watchdog/placer read concurrently."""
 
-    def __init__(self, label, policy=None, clock=time.monotonic):
+    def __init__(
+        self,
+        label,
+        policy=None,
+        clock=time.monotonic,
+        metric_ns="serve",
+        gauge_prefix="serve_dev",
+    ):
+        """metric_ns / gauge_prefix: the counter namespace and health-gauge
+        prefix this breaker reports under — "serve"/"serve_dev" for the
+        verify pool (the historical names), "issue"/"issue_auth" for the
+        threshold-issuance authority pool (coconut_tpu/issue/). The state
+        machine is surface-agnostic; only the telemetry labels differ."""
         self.label = label
         self.policy = policy if policy is not None else HealthPolicy()
         self.clock = clock
+        self.metric_ns = metric_ns
+        self.gauge = "%s%s_health" % (gauge_prefix, label)
         self.state = HEALTHY
         self.consecutive_failures = 0
         self.probe_ok = 0
@@ -128,7 +142,7 @@ class ExecutorHealth:
     def _transition(self, new, reason):
         old, self.state = self.state, new
         self.last_reason = reason
-        metrics.set_gauge("serve_dev%s_health" % self.label, new)
+        metrics.set_gauge(self.gauge, new)
         if otrace.enabled():
             # instant span: one record per transition, greppable by
             # executor label in the export
@@ -155,7 +169,7 @@ class ExecutorHealth:
                     # breaker closes; de-escalate the cooldown so the NEXT
                     # incident starts from the base again
                     self.cooldown_s = self.policy.probe_after_s
-                    metrics.count("serve_recovered")
+                    metrics.count("%s_recovered" % self.metric_ns)
                     return self._transition(
                         HEALTHY, "probe ladder closed the breaker"
                     )
@@ -172,7 +186,7 @@ class ExecutorHealth:
             if self.state == QUARANTINED:
                 return None
             if self.state == PROBATION:
-                metrics.count("serve_probe_failures")
+                metrics.count("%s_probe_failures" % self.metric_ns)
                 return self._quarantine_locked(
                     "probe failed: %s" % reason, escalate=True
                 )
@@ -193,7 +207,7 @@ class ExecutorHealth:
             if self.state == QUARANTINED:
                 return None
             if self.state == PROBATION:
-                metrics.count("serve_probe_failures")
+                metrics.count("%s_probe_failures" % self.metric_ns)
             return self._quarantine_locked(
                 reason, escalate=self.state == PROBATION
             )
@@ -208,7 +222,7 @@ class ExecutorHealth:
         self.quarantined_at = self.clock()
         self.probe_ok = 0
         self.consecutive_failures = 0
-        metrics.count("serve_quarantined")
+        metrics.count("%s_quarantined" % self.metric_ns)
         return self._transition(QUARANTINED, reason)
 
     # -- half-open promotion (called by the watchdog tick) -------------------

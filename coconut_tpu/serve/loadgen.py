@@ -34,6 +34,19 @@ deltas with occupancy = busy_s / wall) and `placement` (single vs sharded
 routing decisions, plus capacity spills) — the per-device surfaces the
 scaling sweep (bench.py BENCH_SERVE_DEVICES) is built from.
 
+MIXED WORKLOAD (`issue_fraction` > 0, with `issue_service`/`issue_pool`):
+each arrival is a coin flip between a verify request against `service`
+and an ISSUANCE request against the threshold-issuance service
+(coconut_tpu/issue) — the deployment shape where one fleet both mints
+and verifies credentials. Issuance outcomes are tallied separately (a
+minted credential is the truthy verdict; `expect_valid` is always True —
+every accepted mint must verify) and the report grows an "issue" section
+with its own latency percentiles, goodput, batch occupancy, and the
+quorum-health deltas (hedges, discarded partials, quorum-unreachable)
+accumulated over the run. Both workloads share the arrival discipline:
+under a closed loop they compete for the same client threads, which is
+exactly the interference a mixed fleet sees.
+
 Determinism knobs: `rng` (arrival jitter + pool sampling), `clock`, and
 `sleep` are injectable, so tests can drive the generator without
 wall-clock flakiness; the 2-second CI smoke uses the real ones.
@@ -183,6 +196,9 @@ def run_loadgen(
     clock=time.monotonic,
     sleep=time.sleep,
     result_timeout=60.0,
+    issue_service=None,
+    issue_pool=None,
+    issue_fraction=0.0,
 ):
     """Drive `service` for `duration_s` and return the report dict.
 
@@ -190,23 +206,65 @@ def run_loadgen(
     from. arrival: "closed" (concurrency threads, submit-on-completion) or
     "open" (Poisson arrivals at rate_per_s, verdicts awaited at the end).
     The service must already be started; it is NOT drained here — callers
-    own lifecycle (the bench lane drains after reading the report)."""
+    own lifecycle (the bench lane drains after reading the report).
+
+    Mixed workload: with `issue_service` (an issue.IssuanceService) and
+    `issue_pool` (a list of (sig_request, messages, elgamal_sk) tuples),
+    each arrival routes to issuance with probability `issue_fraction`;
+    the report gains an "issue" section. issue_fraction=1.0 drives a
+    pure-issuance run (the bench --issue lane)."""
     if not pool:
         raise ValueError("loadgen pool must be non-empty")
     if arrival not in ("closed", "open"):
         raise ValueError("unknown arrival discipline %r" % (arrival,))
+    if not 0.0 <= issue_fraction <= 1.0:
+        raise ValueError(
+            "issue_fraction must be in [0, 1] (got %r)" % (issue_fraction,)
+        )
+    if issue_fraction > 0.0 and (issue_service is None or not issue_pool):
+        raise ValueError(
+            "issue_fraction > 0 needs issue_service and a non-empty issue_pool"
+        )
     rng = rng if rng is not None else random.Random(0x5E21E)
     tally = _Tally()
+    issue_tally = _Tally()
     occ0_reqs = metrics.get_count("serve_batched_requests")
     occ0_batches = metrics.get_count("serve_batches")
     dev0_counts = metrics.counters_with_prefix("serve_dev")
     dev0_timers = metrics.timers_with_prefix("serve_dev")
     placed0 = metrics.counters_with_prefix("serve_placed")
+    issue0 = metrics.counters_with_prefix("issue")
     stages0 = _stage_totals()
     t0 = clock()
     t_end = t0 + duration_s
 
+    def submit_issue():
+        sig_req, messages, elg_sk = issue_pool[rng.randrange(len(issue_pool))]
+        t_submit = clock()
+        try:
+            fut = issue_service.submit(sig_req, messages, elg_sk, lane=lane)
+        except ServiceOverloadedError:
+            with issue_tally.lock:
+                issue_tally.submitted += 1
+                issue_tally.rejected += 1
+            return None
+        except ServiceBrownoutError:
+            with issue_tally.lock:
+                issue_tally.submitted += 1
+                issue_tally.shed += 1
+            return None
+        except ServiceClosedError:
+            return None
+        with issue_tally.lock:
+            issue_tally.submitted += 1
+        # a minted credential is the truthy verdict; every accepted
+        # issuance MUST mint (the service's verify-before-release gate
+        # makes anything else an error, not an "invalid")
+        return fut, True, t_submit, issue_tally
+
     def submit_one():
+        if issue_fraction > 0.0 and rng.random() < issue_fraction:
+            return submit_issue()
         sig, messages, expect_valid = pool[rng.randrange(len(pool))]
         t_submit = clock()
         try:
@@ -228,7 +286,7 @@ def run_loadgen(
             return None
         with tally.lock:
             tally.submitted += 1
-        return fut, expect_valid, t_submit
+        return fut, expect_valid, t_submit, tally
 
     if arrival == "closed":
 
@@ -237,8 +295,8 @@ def run_loadgen(
                 sub = submit_one()
                 if sub is None:
                     continue
-                fut, expect_valid, t_submit = sub
-                tally.settle(fut, expect_valid, t_submit, clock, result_timeout)
+                fut, expect_valid, t_submit, t_acct = sub
+                t_acct.settle(fut, expect_valid, t_submit, clock, result_timeout)
 
         threads = [
             threading.Thread(target=client, name="loadgen-%d" % i)
@@ -255,8 +313,8 @@ def run_loadgen(
             if sub is not None:
                 outstanding.append(sub)
             sleep(rng.expovariate(rate_per_s))
-        for fut, expect_valid, t_submit in outstanding:
-            tally.settle(fut, expect_valid, t_submit, clock, result_timeout)
+        for fut, expect_valid, t_submit, t_acct in outstanding:
+            t_acct.settle(fut, expect_valid, t_submit, clock, result_timeout)
 
     elapsed = max(clock() - t0, 1e-9)
     d_reqs = metrics.get_count("serve_batched_requests") - occ0_reqs
@@ -264,6 +322,11 @@ def run_loadgen(
     occupancy = (
         d_reqs / (d_batches * service.max_batch) if d_batches else None
     )
+    issue_report = None
+    if issue_service is not None and issue_fraction > 0.0:
+        issue_report = _issue_report(
+            issue_tally, issue_service, issue0, elapsed
+        )
     return {
         "arrival": arrival,
         "duration_s": round(elapsed, 3),
@@ -292,4 +355,44 @@ def run_loadgen(
             if tally.submitted
             else None
         ),
+        "issue_fraction": issue_fraction if issue_report else None,
+        "issue": issue_report,
+    }
+
+
+def _issue_report(t, issue_service, before_counts, elapsed):
+    """The mixed-workload report's issuance section: client-observed
+    outcomes plus the quorum-health counter deltas over the run. Every
+    completion IS a minted-and-verified credential, so `mismatches` > 0
+    (a falsy mint) or `errors` concentrated here point at the issuance
+    pool, not the verify pool."""
+
+    def delta(name):
+        return metrics.get_count(name) - before_counts.get(name, 0)
+
+    fanouts = delta("issue_batches")
+    return {
+        "submitted": t.submitted,
+        "rejected": t.rejected,
+        "shed": t.shed,
+        "minted": t.completed,
+        "errors": t.errors,
+        "dropped_futures": t.dropped,
+        "mint_mismatches": t.mismatches,
+        "latency_s": _percentiles(t.latencies),
+        "goodput_per_s": round(t.completed / elapsed, 2),
+        "mean_batch_occupancy": (
+            round(
+                delta("issue_batched_requests")
+                / (fanouts * issue_service.max_batch),
+                4,
+            )
+            if fanouts
+            else None
+        ),
+        "fanouts": fanouts,
+        "hedges": delta("issue_hedges"),
+        "partials_discarded": delta("issue_partials_discarded"),
+        "corrupt_partials": delta("issue_corrupt_partials"),
+        "quorum_unreachable": delta("issue_quorum_unreachable"),
     }

@@ -143,11 +143,17 @@ class RequestQueue:
     and the batcher. All waiting/flush policy lives in serve/batcher.py;
     this class owns admission, ordering, and close semantics."""
 
-    def __init__(self, max_depth=1024, clock=time.monotonic):
+    def __init__(self, max_depth=1024, clock=time.monotonic, metric_ns="serve"):
+        """metric_ns: the counter namespace admissions report under —
+        "serve" (verify service, the historical names) or "issue" (the
+        threshold-issuance service, coconut_tpu/issue/). The queue itself
+        is payload-agnostic: `sig` is whatever the owning service coalesces
+        (a credential to verify, or an issuance order to blind-sign)."""
         if max_depth < 1:
             raise ValueError("max_depth must be >= 1 (got %r)" % (max_depth,))
         self.max_depth = max_depth
         self.clock = clock
+        self.metric_ns = metric_ns
         self.cond = threading.Condition()
         self.closed = False
         self._lanes = {lane: deque() for lane in LANES}
@@ -168,7 +174,7 @@ class RequestQueue:
                 )
             depth = self._depth_locked()
             if depth >= self.max_depth:
-                metrics.count("serve_rejected")
+                metrics.count("%s_rejected" % self.metric_ns)
                 raise ServiceOverloadedError(depth, self.max_depth)
             req.span = otrace.start_span(
                 "request", root=True, lane=lane, max_wait_ms=max_wait_ms
@@ -176,7 +182,7 @@ class RequestQueue:
             req.queue_span = otrace.start_span("queue_wait", parent=req.span)
             req.future.trace_id = req.span.trace_id
             self._lanes[lane].append(req)
-            metrics.count("serve_admitted")
+            metrics.count("%s_admitted" % self.metric_ns)
             self.cond.notify_all()
         return req.future
 

@@ -23,6 +23,40 @@ from .ps import ps_verify
 from .sss import lagrange_basis_at_0, rand_fr
 
 
+def _validate_share_ids(pairs, threshold):
+    """The (signer_id, value) subset an aggregation will interpolate over
+    must hold `threshold` DISTINCT, in-range (positive integer) share
+    indices: a repeated id would skew its Lagrange weight silently, and an
+    id <= 0 has no Shamir evaluation point (sss.lagrange_basis_at_0 treats
+    0 as the secret itself). Raises GeneralError NAMING the offending ids
+    so an operator can see which authority double-reported or mislabeled
+    its share. Returns the validated id set."""
+    ids = [i for i, _ in pairs]
+    bad = sorted({i for i in ids if not isinstance(i, int) or i < 1})
+    if bad:
+        raise GeneralError(
+            "out-of-range signer ids in aggregation set: %r "
+            "(share indices are 1-based positive integers)" % (bad,)
+        )
+    seen, dup = set(), set()
+    for i in ids:
+        if i in seen:
+            dup.add(i)
+        seen.add(i)
+    if dup:
+        raise GeneralError(
+            "duplicate signer ids in aggregation set: %r "
+            "(a repeated id would skew its Lagrange weight)"
+            % (sorted(dup),)
+        )
+    if len(seen) != threshold:
+        raise GeneralError(
+            "aggregation subset holds %d distinct signer ids, need %d"
+            % (len(seen), threshold)
+        )
+    return seen
+
+
 class Sigkey:
     """Signer secret key: x, y_1..y_q (signature.rs:39-43)."""
 
@@ -57,9 +91,7 @@ class Verkey:
             if len(vk.Y_tilde) != q:
                 raise UnsupportedNoOfMessages(q, len(vk.Y_tilde))
         use = keys[:threshold]
-        ids = {i for i, _ in use}
-        if len(ids) != threshold:
-            raise GeneralError("duplicate signer ids in aggregation set")
+        ids = _validate_share_ids(use, threshold)
         ls = {i: lagrange_basis_at_0(ids, i) for i in ids}
         ops = ctx.other
         X_tilde = ops.msm([vk.X_tilde for i, vk in use], [ls[i] for i, _ in use])
@@ -110,9 +142,7 @@ class Signature:
                 "need at least %d signatures, got %d" % (threshold, len(sigs))
             )
         use = sigs[:threshold]
-        ids = {i for i, _ in use}
-        if len(ids) != threshold:
-            raise GeneralError("duplicate signer ids in aggregation set")
+        ids = _validate_share_ids(use, threshold)
         sigma_1 = use[0][1].sigma_1
         for _, s in use[1:]:
             if s.sigma_1 != sigma_1:
@@ -763,7 +793,12 @@ def batch_blind_sign(sig_requests, sigkey, params, backend=None):
 
 def batch_unblind(blind_sigs, elgamal_sk, ctx, backend=None):
     """User-side Unblind over a batch: sigma_2 = c_tilde_2 - c_tilde_1^sk
-    (signature.rs:436-443), the scalar muls batched as a k=1 distinct MSM."""
+    (signature.rs:436-443), the scalar muls batched as a k=1 distinct MSM.
+
+    `elgamal_sk` is either ONE secret shared by every blind signature (the
+    original single-user batch) or a LIST aligned with `blind_sigs` — the
+    threshold-issuance service unblinds many users' partials in one call,
+    each under its own ElGamal secret (coconut_tpu/issue/quorum.py)."""
     from .backend import get_backend
 
     if not blind_sigs:
@@ -772,6 +807,15 @@ def batch_unblind(blind_sigs, elgamal_sk, ctx, backend=None):
         backend = get_backend("python")
     elif isinstance(backend, str):
         backend = get_backend(backend)
+    if isinstance(elgamal_sk, (list, tuple)):
+        if len(elgamal_sk) != len(blind_sigs):
+            raise GeneralError(
+                "per-signature elgamal_sk list length %d != %d blind "
+                "signatures" % (len(elgamal_sk), len(blind_sigs))
+            )
+        sk_rows = [[sk] for sk in elgamal_sk]
+    else:
+        sk_rows = [[elgamal_sk]] * len(blind_sigs)
     msm = (
         backend.msm_g1_distinct
         if ctx.name == "G1"
@@ -779,13 +823,63 @@ def batch_unblind(blind_sigs, elgamal_sk, ctx, backend=None):
     )
     a_sks = msm(
         [[bs.blinded[0]] for bs in blind_sigs],
-        [[elgamal_sk]] * len(blind_sigs),
+        sk_rows,
     )
     ops = ctx.sig
     return [
         Signature(bs.h, ops.sub(bs.blinded[1], a_sk))
         for bs, a_sk in zip(blind_sigs, a_sks)
     ]
+
+
+def batch_aggregate(threshold, partials_list, ctx=None, backend=None):
+    """Lagrange-aggregate MANY requests' partial-signature subsets in one
+    batched distinct-base MSM (the threshold-issuance hot path,
+    coconut_tpu/issue/quorum.py).
+
+    partials_list: one entry per request, each a list of
+    (signer_id, Signature) pairs — the same shape `Signature.aggregate`
+    takes; every entry is validated the same way (>= threshold partials,
+    distinct in-range ids, shared sigma_1) and aggregated over its FIRST
+    `threshold` pairs. Where `Signature.aggregate` runs one [t]-point MSM
+    per credential, this runs ONE [B, t] distinct MSM through the backend,
+    so minting a coalesced batch costs one dispatch. Bit-identical to the
+    sequential path (tests/test_issue.py pins the parity)."""
+    from .backend import get_backend
+
+    if not partials_list:
+        return []
+    from .params import DEFAULT_CTX
+
+    ctx = ctx or DEFAULT_CTX
+    if backend is None:
+        backend = get_backend("python")
+    elif isinstance(backend, str):
+        backend = get_backend(backend)
+    sigma_1s, rows_bases, rows_exps = [], [], []
+    for sigs in partials_list:
+        if len(sigs) < threshold:
+            raise GeneralError(
+                "need at least %d signatures, got %d" % (threshold, len(sigs))
+            )
+        use = sigs[:threshold]
+        ids = _validate_share_ids(use, threshold)
+        sigma_1 = use[0][1].sigma_1
+        for _, s in use[1:]:
+            if s.sigma_1 != sigma_1:
+                raise GeneralError(
+                    "partial signatures disagree on sigma_1 (different requests?)"
+                )
+        sigma_1s.append(sigma_1)
+        rows_bases.append([s.sigma_2 for _, s in use])
+        rows_exps.append([lagrange_basis_at_0(ids, i) for i, _ in use])
+    msm = (
+        backend.msm_g1_distinct
+        if ctx.name == "G1"
+        else backend.msm_g2_distinct
+    )
+    sigma_2s = msm(rows_bases, rows_exps)
+    return [Signature(s1, s2) for s1, s2 in zip(sigma_1s, sigma_2s)]
 
 
 def fiat_shamir_challenge(transcript_bytes):

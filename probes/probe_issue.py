@@ -1,0 +1,132 @@
+"""Threshold-issuance acceptance smoke (the issue lane's end-to-end check).
+
+    JAX_PLATFORMS=cpu python probes/probe_issue.py
+
+Runs a REAL 5-authority t=3 IssuanceService on the python backend (small
+2-message params) and injects — via faults.FaultyBackend sign-path
+schedules — ONE authority-loop crash and ONE hung sign dispatch on the
+very first fan-out, then asserts the properties ISSUE 10 promises:
+
+  - every submitted order MINTS: no dropped futures, no dangling quorum,
+    despite 2 of 5 authorities failing mid-fan-out (first-t-of-n rides
+    the 3 survivors);
+  - every minted credential VERIFIES under the Lagrange-aggregated
+    verkey of the surviving subset — the release gate is real;
+  - the crash is contained and attributed: issue_authority_crashes >= 1
+    and the culprit authority is quarantined, while the pool keeps
+    minting.
+
+Prints a one-line JSON report (mint counts + quorum-wait percentiles +
+health counters) for the CI log. Everything runs on the CPU in a few
+seconds; the hang is Event-released before drain so no thread outlives
+the probe.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from coconut_tpu import metrics
+from coconut_tpu.backend import get_backend
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.faults import FaultyBackend
+from coconut_tpu.issue import IssuanceService
+from coconut_tpu.keygen import trusted_party_SSS_keygen
+from coconut_tpu.params import Params
+from coconut_tpu.signature import SignatureRequest, Verkey
+from coconut_tpu.sss import rand_fr
+
+THRESHOLD, TOTAL, ORDERS = 3, 5, 8
+
+
+def main():
+    metrics.reset()
+    params = Params.new(2, b"probe-issue")
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params)
+    py = get_backend("python")
+    # authority 2 crashes on its first sign; authority 3 hangs on its
+    # first sign — the pool must mint through 1, 4, 5
+    crasher = FaultyBackend(py, crash_sign_on=(0,))
+    hanger = FaultyBackend(py, hang_sign_on=(0,), hang_max_s=30.0)
+    svc = IssuanceService(
+        signers,
+        params,
+        THRESHOLD,
+        backend="python",
+        backends=[py, crasher, hanger, py, py],
+        max_batch=4,
+        max_wait_ms=5.0,
+    ).start()
+    try:
+        orders = []
+        for _ in range(ORDERS):
+            msgs = [rand_fr(), rand_fr()]
+            sk, pk = elgamal_keygen(params.ctx.sig, params.g)
+            req, _ = SignatureRequest.new(msgs, 1, pk, params)
+            orders.append((req, msgs, sk))
+        futs = [svc.submit(req, msgs, sk) for req, msgs, sk in orders]
+        creds = [fut.result(timeout=120.0) for fut in futs]
+    finally:
+        hanger.hang_release.set()  # free the wedged worker before drain
+        assert svc.drain(timeout=60.0), "drain timed out"
+
+    # every order minted, and every minted credential verifies under the
+    # surviving subset's aggregated verkey (subset-independence: any
+    # t-subset's aggregated verkey is the same group element)
+    vk = Verkey.aggregate(
+        THRESHOLD,
+        [(s.id, s.verkey) for s in signers if s.id in (1, 4, 5)],
+        ctx=params.ctx,
+    )
+    verified = sum(
+        1
+        for cred, (_, msgs, _) in zip(creds, orders)
+        if cred.verify(msgs, vk, params)
+    )
+    assert verified == ORDERS, "only %d/%d credentials verify" % (
+        verified,
+        ORDERS,
+    )
+
+    minted = metrics.get_count("issue_minted")
+    crashes = metrics.get_count("issue_authority_crashes")
+    quarantined = metrics.get_count("issue_quarantined")
+    unreachable = metrics.get_count("issue_quorum_unreachable")
+    assert minted == ORDERS, "service minted %d of %d" % (minted, ORDERS)
+    assert crashes >= 1, "the authority crash was never contained"
+    assert crasher.crashes == 1, "crash injection never dispatched"
+    assert quarantined >= 1, "the crashed authority was not quarantined"
+    assert unreachable == 0, "a fan-out lost quorum with 3 live authorities"
+
+    hist = metrics.snapshot().get("histograms", {})
+    qwait = hist.get("issue_quorum_wait_s", {})
+    print(
+        json.dumps(
+            {
+                "minted": minted,
+                "verified": verified,
+                "authority_crashes": crashes,
+                "quarantined": quarantined,
+                "watchdog_timeouts": metrics.get_count(
+                    "issue_watchdog_timeouts"
+                ),
+                "hedges": metrics.get_count("issue_hedges"),
+                "partials_discarded": metrics.get_count(
+                    "issue_partials_discarded"
+                ),
+                "quorum_wait_s": {
+                    "p50": qwait.get("p50_s"),
+                    "p95": qwait.get("p95_s"),
+                },
+            },
+            sort_keys=True,
+        )
+    )
+    print("issue probe: ok (%d/%d minted+verified)" % (verified, ORDERS))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

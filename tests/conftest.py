@@ -75,6 +75,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "issue: threshold-issuance suite (quorum fan-out, first-t-of-n "
+        "aggregation, straggler hedging, corrupt-partial attribution), "
+        "also run explicitly by ci.sh's issue lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

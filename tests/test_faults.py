@@ -899,6 +899,10 @@ def test_chaos_schedule_is_deterministic_and_replayable():
         "flip_on": [1],
         "delay_on": [],
         "delay_s": 0.0,
+        "fail_sign_on": [],
+        "crash_sign_on": [],
+        "hang_sign_on": [],
+        "corrupt_partial_on": [],
     }
 
 

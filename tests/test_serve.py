@@ -645,24 +645,34 @@ def test_pool_fault_containment_dead_letters_only_one_devices_culprit(
     — per-batch containment is per-device containment."""
     dlq = str(tmp_path / "pool_dead.jsonl")
     be = FaultyBackend(StubGrouped(), raise_on={0})
-    svc = _service(
-        be,
-        mode="grouped",
-        max_batch=2,
-        devices=2,
-        dead_letter_path=dlq,
-        retry_policy=_policy(max_attempts=3),
-    )
-    # submit BEFORE start so coalescing is deterministic: batch 0 =
-    # requests 0-1 (forged at lane 1) -> device 0; batch 1 = requests 2-3
-    # (all valid) -> device 1 (least-loaded, and device 0 is at capacity)
-    futs = [svc.submit(_cred(ok=(i != 1)), [i]) for i in range(4)]
-    svc.start()
-    assert svc.drain(timeout=10.0)
+    from coconut_tpu.obs import trace as otrace
+
+    otrace.enable(ring=256)
+    try:
+        svc = _service(
+            be,
+            mode="grouped",
+            max_batch=2,
+            devices=2,
+            dead_letter_path=dlq,
+            retry_policy=_policy(max_attempts=3),
+        )
+        # submit BEFORE start so coalescing is deterministic: batch A =
+        # requests 0-1 (forged at lane 1) -> device 0; batch B = requests
+        # 2-3 (all valid) -> device 1 (least-loaded, and device 0 is at
+        # capacity). Batch SEQ numbers are assigned launch-side on the
+        # executor threads, so which batch is seq 0 is a scheduling race
+        # — the culprit is pinned via its request's trace_id instead.
+        futs = [svc.submit(_cred(ok=(i != 1)), [i]) for i in range(4)]
+        svc.start()
+        assert svc.drain(timeout=10.0)
+    finally:
+        otrace.disable()
     assert [f.result(0) for f in futs] == [True, False, True, True]
     records = DeadLetterLog.read(dlq)
     assert len(records) == 1
-    assert records[0]["batch"] == 0 and records[0]["credential"] == 1
+    assert records[0]["trace_id"] == futs[1].trace_id
+    assert records[0]["credential"] == 1
     # both devices actually dispatched, one batch each
     assert metrics.get_count("serve_dev0_dispatches") == 1
     assert metrics.get_count("serve_dev1_dispatches") == 1
