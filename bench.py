@@ -21,6 +21,7 @@ Also measured (BASELINE.md configs):
   config 5: short streamed run through verify_stream               [BENCH_STREAM=1]
   serve lane: loadgen against the online CredentialService         [--serve]
   issue lane: loadgen against the online IssuanceService           [--issue]
+  session lane: full-session loadgen against the ProtocolEngine    [--session]
 
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
@@ -55,6 +56,18 @@ outcome counts under "issue". Knobs: BENCH_ISSUE_SECONDS (default 2),
 BENCH_ISSUE_MAX_BATCH (default 4), BENCH_ISSUE_CONCURRENCY (default
 2*max_batch); BENCH_ISSUE=0 skips (the same gate as the offline config-4
 blind-sign lane); composes with --serve and BENCH_OFFLINE=0.
+
+Session lane (`python bench.py --session`): closed-loop FULL protocol
+sessions (prepare -> mint -> show_prove -> show_verify, one credential
+each) against an engine.ProtocolEngine running all five phases on one
+executor pool — embedding sessions/sec, end-to-end session p50/p95/p99,
+the per-phase latency breakdown, and the per-program jit-shape counters
+(flat after warmup = no cross-program recompiles) under "session".
+Knobs: BENCH_SESSION_SECONDS (default 2), BENCH_SESSION_MAX_BATCH
+(default 4), BENCH_SESSION_CONCURRENCY (default 2*max_batch),
+BENCH_SESSION_AUTHORITIES/BENCH_SESSION_THRESHOLD (default 3, t=2);
+BENCH_SESSION=0 skips; composes with the other lanes and
+BENCH_OFFLINE=0.
 
 Chaos-recovery sub-report (ISSUE 9, on by default with --serve;
 BENCH_CHAOS=0 skips): a three-phase loadgen pass — clean, then one
@@ -310,6 +323,89 @@ def bench_issue(ge, params, vk, sigs, msgs_list, extras, backend_name):
     return issue["goodput_per_s"]
 
 
+def bench_session(ge, params, extras, backend_name):
+    """Full-session lane (--session): closed-loop FULL protocol sessions
+    (prepare -> mint -> show_prove -> show_verify, one credential each)
+    against a ProtocolEngine running all five phases on one executor
+    pool. Embeds sessions/sec, end-to-end session p50/p95/p99, and the
+    per-phase latency breakdown under extras["session"]; returns
+    sessions/sec. Knobs: BENCH_SESSION_SECONDS (default 2),
+    BENCH_SESSION_MAX_BATCH (default 4), BENCH_SESSION_CONCURRENCY
+    (default 2*max_batch), BENCH_SESSION_AUTHORITIES /
+    BENCH_SESSION_THRESHOLD (default 3, t=2); BENCH_SESSION=0 skips."""
+    from coconut_tpu import metrics
+    from coconut_tpu.elgamal import elgamal_keygen
+    from coconut_tpu.engine import ProtocolEngine
+    from coconut_tpu.keygen import trusted_party_SSS_keygen
+    from coconut_tpu.serve import run_session_loadgen
+    from coconut_tpu.sss import rand_fr
+
+    seconds = float(os.environ.get("BENCH_SESSION_SECONDS", "2"))
+    max_batch = int(os.environ.get("BENCH_SESSION_MAX_BATCH", "4"))
+    concurrency = int(
+        os.environ.get("BENCH_SESSION_CONCURRENCY", str(2 * max_batch))
+    )
+    total = int(os.environ.get("BENCH_SESSION_AUTHORITIES", "3"))
+    threshold = int(os.environ.get("BENCH_SESSION_THRESHOLD", "2"))
+
+    _, _, signers = trusted_party_SSS_keygen(threshold, total, params)
+    pool = []
+    for _ in range(4 * max_batch):
+        msgs = [rand_fr() for _ in range(ge.MSG_COUNT)]
+        esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+        pool.append((msgs, epk, esk))
+    revealed = list(range(2, ge.MSG_COUNT))
+
+    engine = ProtocolEngine(
+        signers, params, threshold,
+        count_hidden=2, revealed_msg_indices=revealed,
+        backend=backend_name, max_batch=max_batch,
+    )
+    jit0 = {
+        ns: metrics.get_count("%s_jit_shapes" % ns)
+        for ns in ("serve", "prep", "prove", "showv")
+    }
+    with engine:
+        # one full warmup session outside the timed window: every
+        # program's serving shape compiles here, not in the report
+        msgs, epk, esk = pool[0]
+        req, _ = engine.submit_prepare(msgs, epk).result(600.0)
+        cred = engine.submit_mint(req, msgs, esk).result(600.0)
+        proof, chal, rev = engine.submit_show_prove(cred, msgs).result(600.0)
+        assert engine.submit_show_verify(proof, rev, chal).result(600.0)
+        jit_warm = {
+            ns: metrics.get_count("%s_jit_shapes" % ns)
+            for ns in ("serve", "prep", "prove", "showv")
+        }
+        report = run_session_loadgen(
+            engine, pool, duration_s=seconds, concurrency=concurrency
+        )
+    jit_end = {
+        ns: metrics.get_count("%s_jit_shapes" % ns)
+        for ns in ("serve", "prep", "prove", "showv")
+    }
+    assert report["errors"] == 0, "session lane errors: %r" % (report,)
+    assert report["failed_shows"] == 0, (
+        "a minted credential failed show-verify: %r" % (report,)
+    )
+    assert report["sessions_completed"] > 0, (
+        "session lane completed nothing: %r" % (report,)
+    )
+    extras["session"] = {
+        "authorities": total,
+        "threshold": threshold,
+        "max_batch": max_batch,
+        **report,
+        # flat counters after warmup = heterogeneous traffic never
+        # cross-program recompiled (the engine's multiplexing claim)
+        "jit_shapes_after_warmup": jit_warm,
+        "jit_shapes_after_run": jit_end,
+        "jit_shapes_stable": jit_warm == jit_end,
+        "jit_shapes_cold": jit0,
+    }
+    return report["sessions_per_s"]
+
+
 def _bench_chaos_recovery(params, vk, pool, backend_name, mode, max_batch,
                           max_wait_ms):
     """Self-healing recovery datapoint (ISSUE 9): goodput before / during /
@@ -509,10 +605,14 @@ def main():
         "--issue" in sys.argv[1:]
         and os.environ.get("BENCH_ISSUE", "1") == "1"
     )
+    session_flag = (
+        "--session" in sys.argv[1:]
+        and os.environ.get("BENCH_SESSION", "1") == "1"
+    )
     # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
     # offline lanes so the CI online smokes don't pay for them
     offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
-        serve_flag or issue_flag
+        serve_flag or issue_flag or session_flag
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -559,6 +659,12 @@ def main():
         if value is None:
             value = minted_per_s
             metric, unit = "issue_credentials_per_sec", "credentials/sec"
+
+    if session_flag:
+        sessions_per_s = bench_session(ge, params, extras, backend_name)
+        if value is None:
+            value = sessions_per_s
+            metric, unit = "session_sessions_per_sec", "sessions/sec"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

@@ -48,10 +48,12 @@ grep -q '"batch": 1' "$DLQ"
 grep -q '"credential": 2' "$DLQ"
 grep -q '"reason"' "$DLQ"
 grep -q '"attempts"' "$DLQ"
-# schema v2: every line carries trace join keys (null with tracing off)
-grep -q '"schema": 2' "$DLQ"
+# schema v3: every line carries trace join keys (null with tracing off)
+# and the engine program name (null on the offline stream path)
+grep -q '"schema": 3' "$DLQ"
 grep -q '"trace_id"' "$DLQ"
 grep -q '"span_id"' "$DLQ"
+grep -q '"program"' "$DLQ"
 echo "dead-letter schema: ok"
 
 echo "== serve lane (dynamic batching / admission control / loadgen) =="
@@ -181,6 +183,42 @@ print("issue smoke: ok (%.1f credentials/s, quorum-wait p95 %.0f ms, "
                           report["hedge_rate"]))
 EOF
 
+echo "== engine lane (unified fabric: five programs / one pool / session pipeline) =="
+# the marker suite: typed retriable-error hierarchy, online/offline show
+# parity through engine lanes (padding + ragged tails), mixed-program
+# full-session pipeline, jit-shape-cache stability
+python -m pytest tests/ -m engine -q
+# end-to-end acceptance smoke (ISSUE 12): a real ProtocolEngine runs all
+# FIVE phases over one 2-executor pool + 3-authority t=2 mint pool, takes
+# one injected executor crash mid-workload; the probe asserts every
+# future settled, the full sessions round-trip (mint -> verify -> show),
+# the crash was contained+redistributed, and the per-program jit-shape
+# counters stayed flat after warmup (no cross-program recompiles)
+JAX_PLATFORMS=cpu python probes/probe_engine.py
+# full-session bench smoke: closed-loop sessions (prepare -> mint ->
+# show_prove -> show_verify) against the real engine on the CPU backend,
+# asserted from the JSON artifact a human reads
+SESSION_JSON=$(mktemp -d)/session.json
+BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 BENCH_CHAOS=0 \
+  BENCH_SESSION_SECONDS=1.5 BENCH_SESSION_MAX_BATCH=4 JAX_PLATFORMS=cpu \
+  python bench.py --session > "$SESSION_JSON"
+SESSION_JSON_PATH="$SESSION_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["SESSION_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["session"]
+assert report["sessions_completed"] > 0, report
+assert report["errors"] == 0, report
+assert report["failed_shows"] == 0, report
+assert report["jit_shapes_stable"], report
+assert report["session_latency_s"]["p95"] is not None, report
+print("session smoke: ok (%.1f sessions/s, p95 %.0f ms, jit shapes "
+      "stable across %d programs)" % (
+          report["sessions_per_s"],
+          report["session_latency_s"]["p95"] * 1000.0,
+          len(report["per_program"])))
+EOF
+
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q
 # end-to-end acceptance smoke on the REAL service (CPU, stub backend):
@@ -218,7 +256,8 @@ with svc:
     verdicts = [f.result(30.0) for f in futs]
 assert verdicts == [True, True, False, True], verdicts
 (rec,) = DeadLetterLog.read(dlq)
-assert rec["schema"] == 2 and rec["trace_id"] == futs[2].trace_id, rec
+assert rec["schema"] == 3 and rec["trace_id"] == futs[2].trace_id, rec
+assert rec["program"] == "verify", rec
 tree = otrace.get_tracer().spans_for(futs[2].trace_id)
 names = {s.name for s in tree}
 assert names >= {"request", "queue_wait", "batch", "coalesce", "dispatch",

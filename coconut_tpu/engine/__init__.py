@@ -1,0 +1,50 @@
+"""The unified execution engine (PR 12): one program-agnostic executor
+fabric — pool + placer + health/watchdog/brownout + metrics/tracing
+seams — running every online Coconut phase as a registered *program*.
+
+Layout:
+
+  executor.py  Executor: one device's inbox worker thread (the PR-6
+               launch/settle async double-buffer), lifted verbatim out of
+               serve/service.py, plus a per-program dispatch registry so
+               one pool multiplexes heterogeneous batches.
+  program.py   Program: the registration contract — (assemble/encode fn,
+               dispatch closure, demux fn, pad-lane convention, SLO
+               class, jit-shape cache key) plus lifecycle/health hooks.
+  core.py      ExecutionEngine: the fabric itself. Owns the queues (one
+               bounded RequestQueue + Batcher per program), the executor
+               pool, placement, the health registry, the watchdog loop,
+               brownout admission, and the generic launch/settle path.
+  phases.py    The three phases that had no online path before PR 12:
+               PrepareProgram (batched prepare-blind-sign), ShowProve-
+               Program (batched selective-disclosure prove), ShowVerify-
+               Program (batched show-verify with identity-lane pads).
+  session.py   ProtocolEngine: all five phases registered on ONE engine
+               instance — full prepare -> mint -> show-prove ->
+               show-verify sessions against a single pool.
+
+serve.CredentialService and issue.IssuanceService are thin program
+registrations on this engine (VerifyProgram and MintProgram); their
+public APIs, metric names, and span shapes are unchanged.
+"""
+
+from .core import ExecutionEngine
+from .executor import Executor
+from .program import Program
+
+__all__ = [
+    "ExecutionEngine",
+    "Executor",
+    "Program",
+    "ProtocolEngine",
+]
+
+
+def __getattr__(name):
+    # ProtocolEngine pulls in serve/ and issue/ (which import engine.core)
+    # — resolve it lazily to keep the package import acyclic
+    if name == "ProtocolEngine":
+        from .session import ProtocolEngine
+
+        return ProtocolEngine
+    raise AttributeError(name)

@@ -1,0 +1,248 @@
+"""The remaining online Coconut phases as engine programs (PR 12).
+
+PR 6-10 put two of the protocol's five phases online (verify —
+serve.VerifyProgram; blind-sign/mint — issue.MintProgram). This module
+registers the other three as first-class online workloads on the SAME
+executor pool, each with its own queue, metric namespace, SLO class,
+pad-lane convention, and jit-shape cache key:
+
+  PrepareProgram     user-side PrepareBlindSign, batched ("prep" ns,
+                     bulk SLO): coalesced unrelated users, each
+                     encrypting under their OWN ElGamal key — the
+                     per-request-pk extension of
+                     signature.batch_prepare_blind_sign. Pad lanes
+                     repeat the last request's row (every lane is
+                     independent; pad outputs are discarded).
+  ShowProveProgram   prover side of Show ("prove" ns, interactive SLO):
+                     pok_sig.batch_show over the coalesced credentials,
+                     one shared revealed-index set per program instance.
+                     Pad lanes repeat the last credential.
+  ShowVerifyProgram  verifier side of Show ("showv" ns, interactive
+                     SLO): ps.batch_show_verify with EXPLICIT per-lane
+                     challenges. Pad lanes clone the first proof (and
+                     its challenge) — a structurally valid row whose
+                     verdict is discarded, keeping the fused kernel's
+                     uniform revealed-index shape.
+
+All three ride the shared device pool: engine._seed_pool_program gives
+every executor a per-program dispatch closure, and the per-program
+"%ns_jit_shapes" counters prove warmed-up cross-program traffic never
+recompiles. engine/session.ProtocolEngine registers all five phases on
+one engine instance."""
+
+from .. import metrics
+from ..obs import trace as otrace
+from .program import Program
+
+
+class ShowOrder:
+    """One show-verify submission: the proof plus its Fiat-Shamir
+    challenge (None = recompute from the transcript at assemble time)."""
+
+    __slots__ = ("proof", "challenge")
+
+    def __init__(self, proof, challenge=None):
+        self.proof = proof
+        self.challenge = challenge
+
+
+def _demux_results(requests, results, metric_ns, clock):
+    """Resolve each request's future with its own lane's output (pad
+    lanes beyond len(requests) are discarded)."""
+    with otrace.span("demux", n=len(requests)):
+        now = clock()
+        for req, out in zip(requests, results):
+            metrics.observe("%s_latency_s" % metric_ns, now - req.t_submit)
+            req.span.end(ok=True)
+            req.future.set_result(out)
+        metrics.count("%s_done" % metric_ns, len(requests))
+
+
+class PrepareProgram(Program):
+    """Batched user-side PrepareBlindSign: submit (messages, elgamal_pk),
+    receive (SignatureRequest, randomness) — randomness = [r, k_1..k_h],
+    the PoK witness. One `count_hidden` per program instance (the
+    batchable shape)."""
+
+    name = "prepare"
+    metric_ns = "prep"
+    slo_class = "bulk"  # throughput work: first to shed under brownout
+    pad_convention = "repeat-last-row"
+
+    def __init__(self, params, count_hidden, backend=None, max_batch=64,
+                 max_wait_ms=20.0, max_depth=1024, pad_partial=True):
+        self.params = params
+        self.count_hidden = count_hidden
+        self.backend = backend
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.max_depth = max_depth
+        self.pad_partial = pad_partial
+
+    def make_dispatch(self, device=None):
+        from ..signature import batch_prepare_blind_sign
+
+        params, count_hidden, backend = (
+            self.params, self.count_hidden, self.backend,
+        )
+
+        def dispatch(messages_list, pks):
+            out = batch_prepare_blind_sign(
+                messages_list, count_hidden, list(pks), params,
+                backend=backend,
+            )
+            return lambda: out
+
+        return dispatch, False
+
+    def assemble(self, requests, bspan):
+        messages_list = [list(r.messages) for r in requests]
+        pks = [r.sig for r in requests]
+        n_pad = max(0, self.max_batch - len(requests))
+        if self.pad_partial and n_pad:
+            messages_list.extend([list(messages_list[-1])] * n_pad)
+            pks.extend([pks[-1]] * n_pad)
+            metrics.count("prep_pad_lanes", n_pad)
+            bspan.set(n_pad=n_pad)
+        return messages_list, pks
+
+    def demux(self, requests, result, messages_list, pks, seq, attempts,
+              bspan):
+        _demux_results(requests, result, self.metric_ns, self.engine.clock)
+        bspan.end(result="demuxed")
+
+
+class ShowProveProgram(Program):
+    """Batched prover side of Show: submit (credential, messages),
+    receive (proof, challenge, revealed_msgs). One revealed-index set per
+    program instance (pok_sig.batch_show's batchable shape)."""
+
+    name = "show_prove"
+    metric_ns = "prove"
+    slo_class = "interactive"  # a user is waiting on their own proof
+    pad_convention = "repeat-credential"
+
+    def __init__(self, vk, params, revealed_msg_indices, backend=None,
+                 max_batch=64, max_wait_ms=20.0, max_depth=1024,
+                 pad_partial=True):
+        self.vk = vk
+        self.params = params
+        self.revealed_msg_indices = list(revealed_msg_indices)
+        self.backend = backend
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.max_depth = max_depth
+        self.pad_partial = pad_partial
+
+    def make_dispatch(self, device=None):
+        from ..pok_sig import batch_show
+
+        vk, params, revealed, backend = (
+            self.vk, self.params, self.revealed_msg_indices, self.backend,
+        )
+
+        def dispatch(sigs, messages_list):
+            out = batch_show(
+                sigs, vk, params, messages_list, revealed, backend=backend
+            )
+            return lambda: out
+
+        return dispatch, False
+
+    def assemble(self, requests, bspan):
+        sigs = [r.sig for r in requests]
+        messages_list = [list(r.messages) for r in requests]
+        n_pad = max(0, self.max_batch - len(requests))
+        if self.pad_partial and n_pad:
+            sigs.extend([sigs[-1]] * n_pad)
+            messages_list.extend([list(messages_list[-1])] * n_pad)
+            metrics.count("prove_pad_lanes", n_pad)
+            bspan.set(n_pad=n_pad)
+        return sigs, messages_list
+
+    def demux(self, requests, result, sigs, messages_list, seq, attempts,
+              bspan):
+        proofs, challenges, revealed_list = result
+        _demux_results(
+            requests,
+            list(zip(proofs, challenges, revealed_list)),
+            self.metric_ns,
+            self.engine.clock,
+        )
+        bspan.end(result="demuxed")
+
+
+class ShowVerifyProgram(Program):
+    """Batched verifier side of Show: submit a ShowOrder (proof [+
+    challenge]) with its revealed-message map, receive the verdict bool.
+    Challenges are ALWAYS passed explicitly to ps.batch_show_verify —
+    pad lanes clone the first proof, and a cloned lane must reuse its
+    original's challenge, never re-derive one."""
+
+    name = "show_verify"
+    metric_ns = "showv"
+    slo_class = "interactive"
+    pad_convention = "clone-first-proof"
+
+    def __init__(self, vk, params, backend=None, max_batch=64,
+                 max_wait_ms=20.0, max_depth=1024, pad_partial=True):
+        self.vk = vk
+        self.params = params
+        self.backend = backend
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.max_depth = max_depth
+        self.pad_partial = pad_partial
+
+    def make_dispatch(self, device=None):
+        from ..ps import batch_show_verify
+
+        vk, params, backend = self.vk, self.params, self.backend
+
+        def dispatch(proofs, aux):
+            revealed_list, challenges = aux
+            out = batch_show_verify(
+                proofs, vk, params, revealed_list,
+                challenges=challenges, backend=backend,
+            )
+            return lambda: out
+
+        return dispatch, False
+
+    def assemble(self, requests, bspan):
+        from ..signature import fiat_shamir_challenge
+
+        proofs = [r.sig.proof for r in requests]
+        revealed_list = [dict(r.messages) for r in requests]
+        challenges = [
+            r.sig.challenge
+            if r.sig.challenge is not None
+            else fiat_shamir_challenge(
+                r.sig.proof.to_bytes_for_challenge(self.vk, self.params)
+            )
+            for r in requests
+        ]
+        n_pad = max(0, self.max_batch - len(requests))
+        if self.pad_partial and n_pad:
+            proofs.extend([proofs[0]] * n_pad)
+            revealed_list.extend([dict(revealed_list[0])] * n_pad)
+            challenges.extend([challenges[0]] * n_pad)
+            metrics.count("showv_pad_lanes", n_pad)
+            bspan.set(n_pad=n_pad)
+        return proofs, (revealed_list, challenges)
+
+    def demux(self, requests, result, proofs, aux, seq, attempts, bspan):
+        with otrace.span("demux", n=len(requests)):
+            now = self.engine.clock()
+            n_valid = 0
+            for req, bit in zip(requests, result):
+                ok = bool(bit)
+                n_valid += ok
+                metrics.observe(
+                    "showv_latency_s", now - req.t_submit
+                )
+                req.span.end(verdict=ok)
+                req.future.set_result(ok)
+            metrics.count("showv_valid", n_valid)
+            metrics.count("showv_invalid", len(requests) - n_valid)
+        bspan.end(result="demuxed")

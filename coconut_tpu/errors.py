@@ -53,23 +53,42 @@ class TransientBackendError(CoconutError):
     permanent and propagates immediately."""
 
 
-class ServiceOverloadedError(CoconutError):
+class ServiceRetryableError(CoconutError):
+    """Base for every LOUD-but-retriable refusal an online service emits
+    (overload rejection, brownout shedding, quorum loss). The unified
+    contract (coconut_tpu/engine): every subclass carries `program` — the
+    engine program (verify / mint / prepare / show_prove / show_verify)
+    that refused, or None for single-program legacy call sites — and
+    `retry_after_s`, the service's hint for when capacity should be back
+    (None when it has no estimate). Clients branch on this ONE type to
+    implement backoff-and-resubmit without enumerating refusal kinds."""
+
+    def __init__(self, message, program=None, retry_after_s=None):
+        super().__init__(message)
+        self.program = program
+        self.retry_after_s = retry_after_s
+
+
+class ServiceOverloadedError(ServiceRetryableError):
     """The serving layer's bounded request queue is at capacity: admission
     control rejects the request LOUDLY instead of growing the queue without
     bound (serve/queue.py). Callers should back off and resubmit; the
     "serve_rejected" counter tracks how often this fires. Carries `depth`
-    (current) and `max_depth` (the configured admission bound)."""
+    (current) and `max_depth` (the configured admission bound), plus the
+    ServiceRetryableError `program` / `retry_after_s` fields."""
 
-    def __init__(self, depth, max_depth):
+    def __init__(self, depth, max_depth, program=None, retry_after_s=None):
         super().__init__(
             "serving queue at capacity (%d/%d): request rejected by "
-            "admission control — back off and resubmit" % (depth, max_depth)
+            "admission control — back off and resubmit" % (depth, max_depth),
+            program=program,
+            retry_after_s=retry_after_s,
         )
         self.depth = depth
         self.max_depth = max_depth
 
 
-class ServiceBrownoutError(CoconutError):
+class ServiceBrownoutError(ServiceRetryableError):
     """The serving layer is in BROWNOUT: quarantined executors cut the
     pool's capacity, or sustained queue pressure crossed the brownout
     threshold, and graded load-shedding (serve/health.BrownoutPolicy) is
@@ -79,7 +98,14 @@ class ServiceBrownoutError(CoconutError):
     should be back (probation probes re-admitting devices, or the queue
     draining). Counted under "serve_shed_bulk"."""
 
-    def __init__(self, lane, retry_after_s, depth=None, capacity_fraction=None):
+    def __init__(
+        self,
+        lane,
+        retry_after_s,
+        depth=None,
+        capacity_fraction=None,
+        program=None,
+    ):
         detail = []
         if capacity_fraction is not None:
             detail.append("capacity %d%%" % round(capacity_fraction * 100))
@@ -87,15 +113,16 @@ class ServiceBrownoutError(CoconutError):
             detail.append("depth %d" % depth)
         super().__init__(
             "service brownout (%s): %s lane shed — retry after ~%.3gs"
-            % (", ".join(detail) or "degraded", lane, retry_after_s)
+            % (", ".join(detail) or "degraded", lane, retry_after_s),
+            program=program,
+            retry_after_s=retry_after_s,
         )
         self.lane = lane
-        self.retry_after_s = retry_after_s
         self.depth = depth
         self.capacity_fraction = capacity_fraction
 
 
-class QuorumUnreachableError(CoconutError):
+class QuorumUnreachableError(ServiceRetryableError):
     """The threshold-issuance layer cannot assemble t distinct valid
     partial signatures for a request: too many authorities are crashed,
     hung, quarantined, or emitting corrupt partials (coconut_tpu/issue/).
@@ -106,11 +133,13 @@ class QuorumUnreachableError(CoconutError):
     contribute when the service gave up). Counted under
     "issue_quorum_unreachable"."""
 
-    def __init__(self, needed, have, live=0):
+    def __init__(self, needed, have, live=0, program=None, retry_after_s=None):
         super().__init__(
             "issuance quorum unreachable: have %d of %d required partial "
             "signatures with only %d live authorities left able to "
-            "contribute — retry once the pool recovers" % (have, needed, live)
+            "contribute — retry once the pool recovers" % (have, needed, live),
+            program=program,
+            retry_after_s=retry_after_s,
         )
         self.needed = needed
         self.have = have

@@ -67,8 +67,10 @@ from .errors import TransientBackendError
 from .obs import flight as _flight
 from .obs import trace as otrace
 
-#: dead-letter JSONL schema: v2 added trace_id/span_id (absent -> null)
-DEAD_LETTER_SCHEMA = 2
+#: dead-letter JSONL schema: v2 added trace_id/span_id (absent -> null);
+#: v3 adds the engine program name (absent -> null) so one shared-pool
+#: dead-letter file stays attributable per phase
+DEAD_LETTER_SCHEMA = 3
 
 
 class InjectedCrash(BaseException):
@@ -456,13 +458,21 @@ class DeadLetterLog:
         self._records = None  # lazy line count of the live file
 
     def append(
-        self, batch, credential, reason, attempts=(), trace_id=None, span_id=None
+        self,
+        batch,
+        credential,
+        reason,
+        attempts=(),
+        trace_id=None,
+        span_id=None,
+        program=None,
     ):
         """Append one culprit record. trace_id/span_id default to the
         ACTIVE span's (the bisection span, within the batch trace) when
         tracing is enabled; the serve path overrides trace_id with the
-        culprit request's own. Triggers a flight-recorder dump for the
-        recorded trace."""
+        culprit request's own. `program` names the engine program whose
+        batch produced the culprit (schema v3). Triggers a
+        flight-recorder dump for the recorded trace."""
         cur = otrace.current()
         if cur is not None:
             if trace_id is None:
@@ -477,6 +487,7 @@ class DeadLetterLog:
             "attempts": list(attempts),
             "trace_id": trace_id,
             "span_id": span_id,
+            "program": program,
         }
         if self._records is None:
             self._records = (
@@ -499,16 +510,20 @@ class DeadLetterLog:
             self.path,
             "dead_letter",
             trace_id=trace_id,
-            extra={"batch": rec["batch"], "credential": rec["credential"]},
+            extra={
+                "batch": rec["batch"],
+                "credential": rec["credential"],
+                "program": program,
+            },
         )
         return rec
 
     @staticmethod
     def read(path):
         """All records in `path` (empty list if it does not exist).
-        Pre-v2 records are normalized on read: absent trace fields become
-        null, absent schema becomes 1 — readers never need per-version
-        key checks."""
+        Older records are normalized on read: absent trace fields become
+        null (pre-v2), absent program becomes null (pre-v3), absent
+        schema becomes 1 — readers never need per-version key checks."""
         if not os.path.exists(path):
             return []
         with open(path) as f:
@@ -517,4 +532,5 @@ class DeadLetterLog:
             rec.setdefault("schema", 1)
             rec.setdefault("trace_id", None)
             rec.setdefault("span_id", None)
+            rec.setdefault("program", None)
         return recs

@@ -1,5 +1,6 @@
 """The threshold-issuance service: quorum fan-out over a pool of signing
-authorities, first-t-of-n aggregation, and straggler-hedged minting.
+authorities, first-t-of-n aggregation, and straggler-hedged minting —
+packaged as a *program* on the unified execution engine (PR 12).
 
 Where serve/service.py answers "is this credential valid?" against ONE
 verkey, this service MINTS credentials against a t-of-n authority pool:
@@ -8,82 +9,53 @@ each request's SignatureRequest is blind-signed by every live authority
 Lagrange-aggregated, and verified under the subset's aggregated verkey,
 and only a credential that VERIFIES is released to its future.
 
-The pipeline reuses the serving stack wholesale rather than reinventing
-it — the same seams, parameterized to the "issue" metric namespace:
+The generic serving machinery — bounded admission, coalescing, the
+placer thread, the watchdog loop, brownout, lifecycle — is the engine's
+(coconut_tpu/engine). What lives HERE is the mint phase itself:
 
-  admission   serve/queue.RequestQueue  (bounded depth, lanes, futures,
-              spans born at admission; payload = issuance order)
-  coalescing  serve/batcher.Batcher     (full-batch or oldest-deadline
-              flush; the ready gate holds backlog until >= t authorities
-              can accept)
-  health      serve/health.ExecutorHealth per AUTHORITY (circuit breaker:
-              quarantine -> probation -> healthy), health.Watchdog for
-              hung sign dispatches, health.BrownoutPolicy for graded
-              shedding
-  tracing     obs spans: request/queue_wait at admission, an
-              "issue_batch" root per fan-out with unblind/aggregate/
-              verify children on the mint path
+  MintProgram      an own-worker engine program (uses_pool=False): it
+                   brings the SigningAuthority pool instead of riding
+                   the shared device pool, replaces least-loaded
+                   placement with quorum fan-out, keeps its own
+                   authority health registry in the "issue_auth*"
+                   namespace, claims ITS watchdog expiries (hung signs)
+                   via `owns_expiry`, and runs hedge timers + authority
+                   probation in the engine's health tick.
+  IssuanceService  an ExecutionEngine subclass registering ONE
+                   MintProgram, with the historical public API and
+                   every historical metric/span name.
 
-What is NEW here versus the verify pool (issue/ package):
-
-  QUORUM FAN-OUT (quorum.QuorumTracker): one coalesced batch goes to ALL
-  live authorities at once; the batch resolves when the FIRST t distinct
-  partial rows land. The slowest n-t authorities are off the latency
-  path — redundancy is the latency strategy, not just the fault
-  strategy. Late rows (stragglers, hedge losers, abandoned workers) hit
-  a stale guard and are discarded, mirroring PR 9's stale-settle.
-
-  PER-PARTIAL PROVENANCE: every partial row is filed under its
-  authority's signer id. When a minted credential fails verification,
-  each contributing partial is re-verified under ITS authority's own
-  verkey — the culprit is named exactly, fed to that authority's circuit
-  breaker (quarantine after the policy's threshold), its rows dropped,
-  and the mint retried from the next usable subset. A corrupt authority
-  costs a mint round, never a corrupt credential: the release gate is
-  verification under the aggregated verkey.
-
-  STRAGGLER HEDGING (hedge.HedgePolicy/HedgeScheduler): when one
-  authority's sign outlives k x its own latency EMA, the batch is
-  dispatched to a SPARE authority; first-t-wins picks the winner and the
-  loser's row is discarded stale. The hedge k is deliberately smaller
-  than the watchdog's — hedge early (costs one duplicate dispatch),
-  quarantine late (condemns the authority).
-
-Failure ladder, per fan-out: a sign FAULT (exception) marks the target
-failed and re-covers from spares; a sign HANG is expired by the watchdog
-(worker abandoned, authority quarantined, coverage restored); an
-authority-loop CRASH quarantines only that authority. When live + landed
-contributors can no longer reach t, the fan-out's remaining futures fail
-with the typed, retriable QuorumUnreachableError — loud, attributable,
-and never a dangling future. Drain settles everything in flight under
-one shared deadline and sweeps whatever could not reach quorum.
+What is NEW versus the verify pool (issue/ package) is unchanged from
+PR 10 — see quorum.py (QuorumTracker: first-t-wins, per-partial
+provenance, drop-and-retry attribution), hedge.py (straggler hedging:
+hedge early, quarantine late), authority.py (per-share signing
+executors). Failure ladder, per fan-out: a sign FAULT marks the target
+failed and re-covers from spares; a sign HANG is expired by the
+watchdog (worker abandoned, authority quarantined, coverage restored);
+an authority-loop CRASH quarantines only that authority. When live +
+landed contributors can no longer reach t, the fan-out's remaining
+futures fail with the typed, retriable QuorumUnreachableError — loud,
+attributable, and never a dangling future. Drain settles everything in
+flight under one shared deadline and sweeps whatever could not reach
+quorum.
 """
 
 import threading
 import time
 
 from .. import metrics
+from ..engine.core import ExecutionEngine, _remaining
+from ..engine.program import Program
 from ..errors import (
     GeneralError,
     QuorumUnreachableError,
-    ServiceBrownoutError,
-    ServiceClosedError,
 )
 from ..obs import trace as otrace
 from ..serve import health as _health
-from ..serve.batcher import Batcher, fail_all
-from ..serve.queue import RequestQueue
+from ..serve.batcher import fail_all
 from .authority import SigningAuthority
 from .hedge import HedgePolicy, HedgeScheduler
 from .quorum import CryptoMinter, Fanout, QuorumTracker
-
-
-def _remaining(deadline):
-    """Seconds left until `deadline` on the REAL clock (thread joins are
-    wall-time waits even under an injected fake clock); None = no bound."""
-    if deadline is None:
-        return None
-    return max(0.0, deadline - time.monotonic())
 
 
 class IssuanceOrder:
@@ -98,24 +70,20 @@ class IssuanceOrder:
         self.elgamal_sk = elgamal_sk
 
 
-class IssuanceService:
-    """Dynamic-batching threshold-issuance service over a signer pool.
+class MintProgram(Program):
+    """The blind-sign/mint phase as an own-worker engine program: quorum
+    fan-out over the authority pool, first-t-of-n aggregation, hedging.
 
-    signers: keygen.Signer list (id, sigkey share, per-signer verkey) —
-    the authority pool; threshold: t, the quorum size. backend: default
-    backend (instance or name) for every authority AND the minter;
-    backends: optional per-authority override list aligned with signers
-    (chaos tests wrap ONE authority's backend in faults.FaultyBackend
-    without touching the others); devices: optional per-authority jax
-    device list (device-pinned sign dispatch). minter: the resolution
-    crypto (default quorum.CryptoMinter; tests inject a stub to exercise
-    quorum mechanics fake-clock, crypto-free).
+    `label_prefix` namespaces authority labels (and their watchdog/
+    health keys) when the program shares an engine with pool executors
+    whose labels are bare indices — the standalone IssuanceService keeps
+    the historical bare str(signer.id) labels."""
 
-    Self-healing knobs mirror serve/service.py: health_policy per-
-    authority breaker, watchdog for hung signs, watchdog_interval_s the
-    health-tick period (None = tests drive health_tick() by hand),
-    brownout for graded shedding, hedge a hedge.HedgePolicy (None
-    disables hedging)."""
+    name = "mint"
+    metric_ns = "issue"
+    slo_class = "standard"
+    pad_convention = "none"
+    uses_pool = False
 
     def __init__(
         self,
@@ -126,15 +94,11 @@ class IssuanceService:
         backends=None,
         devices=None,
         minter=None,
+        hedge=None,
         max_batch=32,
         max_wait_ms=20.0,
         max_depth=1024,
-        clock=time.monotonic,
-        health_policy=None,
-        watchdog=None,
-        watchdog_interval_s=0.25,
-        hedge=None,
-        brownout=None,
+        label_prefix="",
     ):
         signers = list(signers)
         if not signers:
@@ -154,233 +118,76 @@ class IssuanceService:
                 "devices list length %d != %d signers"
                 % (len(devices), len(signers))
             )
+        self.signers = signers
         self.params = params
         self.threshold = threshold
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
-        self.clock = clock
+        self.max_depth = max_depth
+        self._backend = backend
+        self._backends = backends
+        self._devices = devices
+        self._minter = minter
+        self._hedge = hedge
+        self._label_prefix = label_prefix
+
+    def bind(self, engine):
+        super().bind(engine)
         self._authorities = [
             SigningAuthority(
                 self,
                 s,
-                backend=(backends[i] if backends is not None else backend),
-                device=(devices[i] if devices is not None else None),
+                backend=(
+                    self._backends[i]
+                    if self._backends is not None
+                    else self._backend
+                ),
+                device=(
+                    self._devices[i] if self._devices is not None else None
+                ),
+                label=(
+                    self._label_prefix + str(s.id)
+                    if self._label_prefix
+                    else None
+                ),
             )
-            for i, s in enumerate(signers)
+            for i, s in enumerate(self.signers)
         ]
         self.minter = (
-            minter
-            if minter is not None
+            self._minter
+            if self._minter is not None
             else CryptoMinter(
-                threshold,
-                {s.id: s.verkey for s in signers},
-                params,
-                backend=backend,
+                self.threshold,
+                {s.id: s.verkey for s in self.signers},
+                self.params,
+                backend=self._backend,
             )
         )
-        self._queue = RequestQueue(
-            max_depth=max_depth, clock=clock, metric_ns="issue"
+        self._tracker = QuorumTracker(self.threshold, clock=engine.clock)
+        self.hedge_policy = (
+            self._hedge if self._hedge is not None else HedgePolicy()
         )
-        self._batcher = Batcher(self._queue, max_batch, clock=clock)
-        self._tracker = QuorumTracker(threshold, clock=clock)
-        self.hedge_policy = hedge if hedge is not None else HedgePolicy()
-        self._hedges = HedgeScheduler(clock=clock)
-        self._thread = None
-        self._seq_lock = threading.Lock()
-        self._fanout_seq = 0
+        self._hedges = HedgeScheduler(clock=engine.clock)
         #: dispatch bookkeeping lock: Fanout.targets / Fanout.failed and
         #: spare-selection decisions (quorum-arrival state is under the
         #: tracker's own lock; never take _flock while holding it)
         self._flock = threading.Lock()
-        self._crashed = None
-
-        self.health_policy = (
-            health_policy if health_policy is not None else _health.HealthPolicy()
-        )
-        self._watchdog = (
-            watchdog if watchdog is not None else _health.Watchdog(clock=clock)
-        )
-        self._watchdog_interval_s = watchdog_interval_s
-        self._brownout = (
-            brownout if brownout is not None else _health.BrownoutPolicy()
-        )
         self._healths = {}
         for auth in self._authorities:
             self._health_of(auth.label)
-        self._wd_stop = threading.Event()
-        self._wd_thread = None
         for auth in self._authorities:
             metrics.set_gauge(
                 "issue_auth%s_health" % auth.label, _health.HEALTHY
             )
-        self._refresh_health_gauges()
+        self.refresh_health_gauges()
 
-    # -- client side ---------------------------------------------------------
+    # -- engine hooks --------------------------------------------------------
 
-    def submit(
-        self, sig_request, messages, elgamal_sk, lane="interactive",
-        max_wait_ms=None,
-    ):
-        """Admit one issuance request; returns a ServeFuture resolving to
-        the minted (verified, aggregated) Signature. `messages` is the
-        FULL message vector (hidden + known — the verification gate needs
-        it; the authorities only ever see `sig_request`). Raises
-        ServiceBrownoutError / ServiceOverloadedError / ServiceClosedError
-        exactly like the verify service."""
-        if self._crashed is not None:
-            raise ServiceClosedError(
-                "issuance service crashed: %r" % (self._crashed,)
-            )
-        depth = self._queue.depth()
-        capacity = self._capacity_fraction()
-        active, retry_after = self._brownout.check(
-            lane, depth, self._queue.max_depth, capacity
-        )
-        metrics.set_gauge("issue_brownout", 1 if active else 0)
-        if retry_after is not None:
-            metrics.count("issue_shed_bulk")
-            raise ServiceBrownoutError(
-                lane, retry_after, depth=depth, capacity_fraction=capacity
-            )
-        return self._queue.submit(
-            IssuanceOrder(sig_request, elgamal_sk),
-            messages,
-            lane=lane,
-            max_wait_ms=(
-                self.max_wait_ms if max_wait_ms is None else max_wait_ms
-            ),
-        )
+    @property
+    def _queue(self):
+        return self.engine._runtimes[self.name].queue
 
-    def depth(self):
-        return self._queue.depth()
-
-    def kick(self):
-        """Wake the placer to re-read the clock (fake-clock tests)."""
-        self._queue.kick()
-
-    # -- lifecycle -----------------------------------------------------------
-
-    def start(self):
-        if self._thread is None:
-            for auth in self._authorities:
-                auth.start()
-            self._thread = threading.Thread(
-                target=self._run, name="coconut-issue", daemon=True
-            )
-            self._thread.start()
-            if self._watchdog_interval_s is not None:
-                self._wd_thread = threading.Thread(
-                    target=self._watchdog_loop,
-                    name="coconut-issue-watchdog",
-                    daemon=True,
-                )
-                self._wd_thread.start()
-        return self
-
-    def drain(self, timeout=None):
-        """Close intake, settle every accepted request, join the pool.
-        Every accepted future is resolved on return: minted, failed
-        typed, or — for fan-outs that could not reach quorum before the
-        shared deadline — failed with QuorumUnreachableError."""
-        deadline = None if timeout is None else time.monotonic() + timeout
-        self._queue.close()
-        ok = True
-        if self._thread is None:
-            fail_all(
-                self._queue.drain_pending(),
-                ServiceClosedError("service drained before start()"),
-                counter="issue_cancelled",
-            )
-        else:
-            self._thread.join(_remaining(deadline))
-            ok = not self._thread.is_alive()
-        for auth in self._authorities:
-            auth.close()
-        for auth in self._authorities:
-            ok = auth.join(_remaining(deadline)) and ok
-        self._sweep_unreachable()
-        return self._stop_watchdog(deadline) and ok
-
-    def shutdown(self, drain=True, timeout=None):
-        """drain=False refuses the queued backlog (ServiceClosedError)
-        but still settles fan-outs already dispatched."""
-        if drain:
-            return self.drain(timeout)
-        deadline = None if timeout is None else time.monotonic() + timeout
-        self._queue.close()
-        fail_all(
-            self._queue.drain_pending(),
-            ServiceClosedError("service shut down before this request ran"),
-            counter="issue_cancelled",
-        )
-        ok = True
-        if self._thread is not None:
-            self._thread.join(_remaining(deadline))
-            ok = not self._thread.is_alive()
-        for auth in self._authorities:
-            auth.close()
-        for auth in self._authorities:
-            ok = auth.join(_remaining(deadline)) and ok
-        self._sweep_unreachable()
-        return self._stop_watchdog(deadline) and ok
-
-    def _stop_watchdog(self, deadline):
-        thread = self._wd_thread
-        if thread is None:
-            return True
-        self._wd_stop.set()
-        thread.join(_remaining(deadline))
-        return not thread.is_alive()
-
-    def _sweep_unreachable(self):
-        """Drain's last act: any fan-out still open could not assemble a
-        quorum in time — fail its unresolved futures loudly (typed,
-        retriable) so no caller ever hangs on a dropped future."""
-        for f in self._tracker.outstanding():
-            with self._flock:
-                have = len(f.available_ids())
-            pending = [i for i in f.pending if not f.requests[i].future.done()]
-            if pending:
-                metrics.count("issue_quorum_unreachable")
-                self._fail_requests(
-                    f,
-                    pending,
-                    QuorumUnreachableError(self.threshold, have, live=0),
-                )
-            self._close_fanout(f, result="swept")
-
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, exc_type, exc, tb):
-        self.drain()
-        return False
-
-    # -- health --------------------------------------------------------------
-
-    def _health_of(self, label):
-        h = self._healths.get(label)
-        if h is None:
-            h = self._healths[label] = _health.ExecutorHealth(
-                label,
-                self.health_policy,
-                clock=self.clock,
-                metric_ns="issue",
-                gauge_prefix="issue_auth",
-            )
-        return h
-
-    def _admits(self, auth):
-        """May NEW fan-out work target `auth`? Same half-open discipline
-        as the verify pool: PROBATION gets one probe dispatch at a time."""
-        h = self._health_of(auth.label)
-        if not h.admissible():
-            return False
-        if h.state == _health.PROBATION and auth.queued() > 0:
-            return False
-        return True
-
-    def _capacity_fraction(self):
+    def capacity_fraction(self):
         ok = sum(
             1
             for a in self._authorities
@@ -388,7 +195,13 @@ class IssuanceService:
         )
         return ok / len(self._authorities)
 
-    def _refresh_health_gauges(self):
+    def capacity_ready(self):
+        return self._has_quorum_capacity()
+
+    def place(self, batch):
+        self._fan_out(batch)
+
+    def refresh_health_gauges(self):
         metrics.set_gauge(
             "issue_healthy_authorities",
             sum(
@@ -398,74 +211,69 @@ class IssuanceService:
             ),
         )
 
-    def _note_success(self, auth):
-        change = self._health_of(auth.label).on_success()
-        if change:
-            self._refresh_health_gauges()
-            self._queue.kick()
+    def start_workers(self):
+        for auth in self._authorities:
+            auth.start()
 
-    def _note_failure(self, auth, reason):
-        """A sign dispatch (or a partial-signature attribution) failed ON
-        this authority: feed its breaker; on quarantine, move its queued
-        fan-outs' coverage to spares (soft — the worker stays alive)."""
-        change = self._health_of(auth.label).on_failure(reason)
-        if change:
-            self._refresh_health_gauges()
-            self._queue.kick()
-            if change[1] == _health.QUARANTINED:
-                for f in auth.sweep_inbox():
-                    self._mark_failed(f, auth.label)
-                    self._ensure_coverage(f)
+    def close_workers(self):
+        for auth in self._authorities:
+            auth.close()
 
-    def _authority_failed(self, auth, exc, inflight, gen):
-        """Authority-loop crash containment (runs ON the dying worker's
-        thread): quarantine ONLY this authority, re-cover its fan-outs
-        from spares. Stale generations (already abandoned by the
-        watchdog) do nothing."""
-        if not auth.is_current(gen):
+    def join_workers(self, deadline):
+        ok = True
+        for auth in self._authorities:
+            ok = auth.join(_remaining(deadline)) and ok
+        return ok
+
+    def on_drain(self):
+        self._sweep_unreachable()
+
+    def on_crash(self, e):
+        """Engine crash sweep: fail every open fan-out's unresolved
+        futures with the crash exception, close the authority pool."""
+        for f in self._tracker.outstanding():
+            pending = [
+                i for i in f.pending if not f.requests[i].future.done()
+            ]
+            if pending:
+                self._fail_requests(f, pending, e)
+            self._close_fanout(f, result="crashed")
+        for auth in self._authorities:
+            auth.close()
+
+    def owns_expiry(self, entry):
+        # watchdog entries this program began carry a Fanout payload;
+        # pool dispatches carry a request list
+        return isinstance(entry[2], Fanout)
+
+    def handle_expired(self, entry, now):
+        """One hung sign: abandon the stuck worker, quarantine its
+        authority, restore the fan-out's quorum coverage."""
+        label, fid, fanout, span, overdue_s = entry
+        metrics.count("issue_watchdog_timeouts")
+        if span is not None:
+            span.event(
+                "watchdog_timeout",
+                authority=label,
+                overdue_s=round(overdue_s, 6),
+            )
+        auth = self._auth_by_label(label)
+        if auth is None:
             return
-        metrics.count("issue_authority_crashes")
-        self._health_of(auth.label).on_crash(
-            "authority loop crash: %s" % type(exc).__name__
-        )
+        self._health_of(label).on_crash("hung sign: watchdog timeout")
         swept = auth.abandon()
-        self._watchdog.forget_label(auth.label)
-        self._refresh_health_gauges()
-        affected = ([inflight] if inflight is not None else []) + swept
-        for f in affected:
-            self._mark_failed(f, auth.label)
+        self.engine._watchdog.forget_label(label)
+        self.refresh_health_gauges()
+        self._hedges.end(fid, label)
+        for f in [fanout] + swept:
+            self._mark_failed(f, label)
             self._ensure_coverage(f)
-        self._queue.kick()
+        self.engine._kick_all()
 
-    def health_tick(self, now=None):
-        """One self-healing sweep: expire hung signs (abandon the stuck
-        worker, quarantine its authority, restore quorum coverage), fire
-        due hedges (dispatch a spare for each straggling sign), and
-        promote cooled-down authorities into probation. Runs on the
-        watchdog thread in production; fake-clock tests call it directly."""
-        if self._crashed is not None:
-            return
-        now = self.clock() if now is None else now
-        for label, fid, fanout, span, overdue_s in self._watchdog.expire(now):
-            metrics.count("issue_watchdog_timeouts")
-            if span is not None:
-                span.event(
-                    "watchdog_timeout",
-                    authority=label,
-                    overdue_s=round(overdue_s, 6),
-                )
-            auth = self._auth_by_label(label)
-            if auth is None:
-                continue
-            self._health_of(label).on_crash("hung sign: watchdog timeout")
-            swept = auth.abandon()
-            self._watchdog.forget_label(label)
-            self._refresh_health_gauges()
-            self._hedges.end(fid, label)
-            for f in [fanout] + swept:
-                self._mark_failed(f, label)
-                self._ensure_coverage(f)
-            self._queue.kick()
+    def tick(self, now):
+        """Per-health-tick: fire due hedges (dispatch a spare for each
+        straggling sign) and promote cooled-down authorities into
+        half-open probation."""
         for fanout, label, overdue_s in self._hedges.due(now):
             if fanout.resolved:
                 continue
@@ -484,21 +292,98 @@ class IssuanceService:
         for auth in self._authorities:
             if self._health_of(auth.label).try_probation(now):
                 auth.start()  # respawn an abandoned worker; no-op otherwise
-                self._refresh_health_gauges()
-                self._queue.kick()
+                self.refresh_health_gauges()
+                self.engine._kick_all()
 
-    def _watchdog_loop(self):
-        while not self._wd_stop.wait(self._watchdog_interval_s):
-            try:
-                self.health_tick()
-            except Exception:
-                metrics.count("issue_health_tick_errors")
+    # -- health --------------------------------------------------------------
+
+    def _health_of(self, label):
+        h = self._healths.get(label)
+        if h is None:
+            h = self._healths[label] = _health.ExecutorHealth(
+                label,
+                self.engine.health_policy,
+                clock=self.engine.clock,
+                metric_ns="issue",
+                gauge_prefix="issue_auth",
+            )
+        return h
+
+    def _admits(self, auth):
+        """May NEW fan-out work target `auth`? Same half-open discipline
+        as the verify pool: PROBATION gets one probe dispatch at a time."""
+        h = self._health_of(auth.label)
+        if not h.admissible():
+            return False
+        if h.state == _health.PROBATION and auth.queued() > 0:
+            return False
+        return True
+
+    def _note_success(self, auth):
+        change = self._health_of(auth.label).on_success()
+        if change:
+            self.refresh_health_gauges()
+            self.engine._kick_all()
+
+    def _note_failure(self, auth, reason):
+        """A sign dispatch (or a partial-signature attribution) failed ON
+        this authority: feed its breaker; on quarantine, move its queued
+        fan-outs' coverage to spares (soft — the worker stays alive)."""
+        change = self._health_of(auth.label).on_failure(reason)
+        if change:
+            self.refresh_health_gauges()
+            self.engine._kick_all()
+            if change[1] == _health.QUARANTINED:
+                for f in auth.sweep_inbox():
+                    self._mark_failed(f, auth.label)
+                    self._ensure_coverage(f)
+
+    def _authority_failed(self, auth, exc, inflight, gen):
+        """Authority-loop crash containment (runs ON the dying worker's
+        thread): quarantine ONLY this authority, re-cover its fan-outs
+        from spares. Stale generations (already abandoned by the
+        watchdog) do nothing."""
+        if not auth.is_current(gen):
+            return
+        metrics.count("issue_authority_crashes")
+        self._health_of(auth.label).on_crash(
+            "authority loop crash: %s" % type(exc).__name__
+        )
+        swept = auth.abandon()
+        self.engine._watchdog.forget_label(auth.label)
+        self.refresh_health_gauges()
+        affected = ([inflight] if inflight is not None else []) + swept
+        for f in affected:
+            self._mark_failed(f, auth.label)
+            self._ensure_coverage(f)
+        self.engine._kick_all()
 
     def _auth_by_label(self, label):
         for a in self._authorities:
             if a.label == label:
                 return a
         return None
+
+    def _sweep_unreachable(self):
+        """Drain's last act: any fan-out still open could not assemble a
+        quorum in time — fail its unresolved futures loudly (typed,
+        retriable) so no caller ever hangs on a dropped future."""
+        for f in self._tracker.outstanding():
+            with self._flock:
+                have = len(f.available_ids())
+            pending = [
+                i for i in f.pending if not f.requests[i].future.done()
+            ]
+            if pending:
+                metrics.count("issue_quorum_unreachable")
+                self._fail_requests(
+                    f,
+                    pending,
+                    QuorumUnreachableError(
+                        self.threshold, have, live=0, program=self.name
+                    ),
+                )
+            self._close_fanout(f, result="swept")
 
     # -- fan-out -------------------------------------------------------------
 
@@ -520,10 +405,8 @@ class IssuanceService:
         """Open one fan-out for a coalesced batch and dispatch it to
         every live authority at once (first-t-wins makes over-dispatch
         the latency strategy)."""
-        with self._seq_lock:
-            fid = self._fanout_seq
-            self._fanout_seq += 1
-        now = self.clock()
+        fid = self.engine._next_seq()
+        now = self.engine.clock()
         targets = [
             a for a in self._authorities if self._admits(a) and a.can_accept()
         ]
@@ -539,7 +422,9 @@ class IssuanceService:
             metrics.count("issue_quorum_unreachable")
             fail_all(
                 requests,
-                QuorumUnreachableError(self.threshold, 0, live=len(targets)),
+                QuorumUnreachableError(
+                    self.threshold, 0, live=len(targets), program=self.name
+                ),
                 counter="issue_failed_requests",
             )
             return
@@ -577,14 +462,14 @@ class IssuanceService:
         """Dispatch one fan-out to one authority: deadline-track the sign
         (watchdog from BEFORE the dispatch — a hung sign never returns),
         arm its hedge timer, enqueue."""
-        now = self.clock() if now is None else now
+        now = self.engine.clock() if now is None else now
         with self._flock:
             if fanout.resolved or auth.label in fanout.targets:
                 return False
             fanout.targets[auth.label] = auth
         if self._health_of(auth.label).state == _health.PROBATION:
             metrics.count("issue_probes")
-        self._watchdog.begin(
+        self.engine._watchdog.begin(
             auth.label, fanout.fid, fanout, span=fanout.bspan, now=now
         )
         self._hedges.begin(
@@ -650,7 +535,9 @@ class IssuanceService:
         self._fail_requests(
             fanout,
             pending,
-            QuorumUnreachableError(self.threshold, have, live=have),
+            QuorumUnreachableError(
+                self.threshold, have, live=have, program=self.name
+            ),
         )
         if self._tracker.settle(fanout, pending):
             self._close_fanout(fanout, result="unreachable")
@@ -665,18 +552,20 @@ class IssuanceService:
             # first-t-wins already resolved this fan-out (cancel raced
             # the pop): skip the sign, settle the trackers
             metrics.count("issue_sign_skips")
-            self._watchdog.end(auth.label, fanout.fid, now=self.clock())
+            self.engine._watchdog.end(
+                auth.label, fanout.fid, now=self.engine.clock()
+            )
             self._hedges.end(fanout.fid, auth.label)
             return
-        t0 = self.clock()
+        t0 = self.engine.clock()
         try:
             with metrics.timer(auth.busy_timer):
                 partials = auth.sign(fanout.sig_reqs, self.params)
         except Exception as e:
             # sign FAULT (not a crash — the worker survives): mark this
             # target failed, breaker the authority, restore coverage
-            self._watchdog.end(
-                auth.label, fanout.fid, ok=False, now=self.clock()
+            self.engine._watchdog.end(
+                auth.label, fanout.fid, ok=False, now=self.engine.clock()
             )
             self._mark_failed(fanout, auth.label)
             self._note_failure(
@@ -684,13 +573,13 @@ class IssuanceService:
             )
             self._ensure_coverage(fanout)
             return
-        now = self.clock()
+        now = self.engine.clock()
         if not auth.is_current(gen):
             # stale worker: the watchdog expired this sign and the
             # fan-out was re-covered — the late row is nobody's news
             metrics.count("issue_partials_discarded", len(partials))
             return
-        self._watchdog.end(auth.label, fanout.fid, now=now)
+        self.engine._watchdog.end(auth.label, fanout.fid, now=now)
         self._hedges.end(fanout.fid, auth.label)
         self.hedge_policy.observe(auth.label, now - t0)
         self._note_success(auth)
@@ -804,7 +693,7 @@ class IssuanceService:
         """Hand verified credentials to their futures — the ONLY path a
         credential leaves the service on, and it is behind the verify
         gate by construction."""
-        now = self.clock()
+        now = self.engine.clock()
         for idx in indices:
             r = fanout.requests[idx]
             metrics.observe("issue_latency_s", now - r.t_submit)
@@ -828,42 +717,125 @@ class IssuanceService:
         deadline too; one mid-sign finishes and ends its own)."""
         self._tracker.close_fanout(fanout)
         self._hedges.cancel(fanout.fid)
-        now = self.clock()
+        now = self.engine.clock()
         for auth in self._authorities:
             if auth.cancel(fanout.fid):
-                self._watchdog.end(auth.label, fanout.fid, now=now)
+                self.engine._watchdog.end(auth.label, fanout.fid, now=now)
                 metrics.count("issue_cancelled_signs")
         fanout.bspan.end(result=result)
 
-    # -- placer --------------------------------------------------------------
 
-    def _crash(self, e):
-        """Placer crash: sweep every queued and open future with the
-        crash exception — no caller ever hangs."""
-        self._crashed = e
-        self._queue.close()
-        fail_all(
-            self._queue.drain_pending(), e, counter="issue_failed_requests"
+class IssuanceService(ExecutionEngine):
+    """Dynamic-batching threshold-issuance service over a signer pool.
+
+    signers: keygen.Signer list (id, sigkey share, per-signer verkey) —
+    the authority pool; threshold: t, the quorum size. backend: default
+    backend (instance or name) for every authority AND the minter;
+    backends: optional per-authority override list aligned with signers
+    (chaos tests wrap ONE authority's backend in faults.FaultyBackend
+    without touching the others); devices: optional per-authority jax
+    device list (device-pinned sign dispatch). minter: the resolution
+    crypto (default quorum.CryptoMinter; tests inject a stub to exercise
+    quorum mechanics fake-clock, crypto-free).
+
+    Self-healing knobs mirror serve/service.py: health_policy per-
+    authority breaker, watchdog for hung signs, watchdog_interval_s the
+    health-tick period (None = tests drive health_tick() by hand),
+    brownout for graded shedding, hedge a hedge.HedgePolicy (None
+    disables hedging)."""
+
+    def __init__(
+        self,
+        signers,
+        params,
+        threshold,
+        backend=None,
+        backends=None,
+        devices=None,
+        minter=None,
+        max_batch=32,
+        max_wait_ms=20.0,
+        max_depth=1024,
+        clock=time.monotonic,
+        health_policy=None,
+        watchdog=None,
+        watchdog_interval_s=0.25,
+        hedge=None,
+        brownout=None,
+    ):
+        super().__init__(
+            name="coconut-issue",
+            metric_ns="issue",
+            clock=clock,
+            health_policy=health_policy,
+            watchdog=watchdog,
+            watchdog_interval_s=watchdog_interval_s,
+            brownout=brownout,
         )
-        for f in self._tracker.outstanding():
-            pending = [
-                i for i in f.pending if not f.requests[i].future.done()
-            ]
-            if pending:
-                self._fail_requests(f, pending, e)
-            self._close_fanout(f, result="crashed")
-        for auth in self._authorities:
-            auth.close()
+        self._crash_msg = "issuance service crashed: %r"
+        self._program = MintProgram(
+            signers,
+            params,
+            threshold,
+            backend=backend,
+            backends=backends,
+            devices=devices,
+            minter=minter,
+            hedge=hedge,
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            max_depth=max_depth,
+        )
+        self.register(self._program)
+        self.params = params
+        self.threshold = threshold
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
 
-    def _run(self):
-        try:
-            while True:
-                batch = self._batcher.next_batch(
-                    block=True, ready=self._has_quorum_capacity
-                )
-                if batch is None:
-                    return
-                self._fan_out(batch)
-        except BaseException as e:
-            self._crash(e)
-            raise
+    # -- client side ---------------------------------------------------------
+
+    def submit(
+        self, sig_request, messages, elgamal_sk, lane="interactive",
+        max_wait_ms=None,
+    ):
+        """Admit one issuance request; returns a ServeFuture resolving to
+        the minted (verified, aggregated) Signature. `messages` is the
+        FULL message vector (hidden + known — the verification gate needs
+        it; the authorities only ever see `sig_request`). Raises
+        ServiceBrownoutError / ServiceOverloadedError / ServiceClosedError
+        exactly like the verify service."""
+        return self.submit_request(
+            "mint",
+            IssuanceOrder(sig_request, elgamal_sk),
+            messages,
+            lane=lane,
+            max_wait_ms=max_wait_ms,
+        )
+
+    # -- historical surface (delegating to the mint program) -----------------
+
+    @property
+    def minter(self):
+        return self._program.minter
+
+    @property
+    def hedge_policy(self):
+        return self._program.hedge_policy
+
+    @property
+    def _authorities(self):
+        return self._program._authorities
+
+    @property
+    def _tracker(self):
+        return self._program._tracker
+
+    @property
+    def _hedges(self):
+        return self._program._hedges
+
+    def _health_of(self, label):
+        return self._program._health_of(label)
+
+    def _capacity_fraction(self):
+        return self._program.capacity_fraction()

@@ -43,15 +43,27 @@ from .health import (
     HealthPolicy,
     Watchdog,
 )
-from .loadgen import run_loadgen
+from .loadgen import run_loadgen, run_session_loadgen
 from .queue import DEFAULT_MAX_WAIT_MS, LANES, RequestQueue, ServeFuture
-from .service import CredentialService
+
+
+def __getattr__(name):
+    # service.py imports the engine, which imports this package's
+    # health/queue/batcher modules — resolve CredentialService lazily so
+    # the package can finish initializing mid-cycle
+    if name == "CredentialService":
+        from .service import CredentialService
+
+        return CredentialService
+    raise AttributeError("module %r has no attribute %r" % (__name__, name))
+
 
 __all__ = [
     "CredentialService",
     "RequestQueue",
     "ServeFuture",
     "run_loadgen",
+    "run_session_loadgen",
     "LANES",
     "DEFAULT_MAX_WAIT_MS",
     "HealthPolicy",

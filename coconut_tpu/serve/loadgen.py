@@ -360,6 +360,149 @@ def run_loadgen(
     }
 
 
+#: the full-session pipeline's phase order (engine/session.ProtocolEngine)
+SESSION_PHASES = ("prepare", "mint", "show_prove", "show_verify")
+
+
+def run_session_loadgen(
+    engine,
+    pool,
+    duration_s=2.0,
+    concurrency=4,
+    lane="interactive",
+    rng=None,
+    clock=time.monotonic,
+    result_timeout=60.0,
+):
+    """Drive FULL protocol sessions against a ProtocolEngine: each client
+    walks one credential through prepare -> mint -> show_prove ->
+    show_verify, end to end, and the report gives end-to-end session
+    latency percentiles NEXT TO per-program goodput — the number the
+    paper's deployment story is judged by (a credential is only useful
+    once it has been minted AND shown).
+
+    pool: non-empty list of (messages, elgamal_pk, elgamal_sk) tuples to
+    sample from (each session mints a fresh credential for its drawn
+    identity). Closed loop only: `concurrency` session threads, each
+    starting its next session when the previous one's show verdict
+    lands — the arrival shape of a saturating enrollment pipeline. A
+    session that fails at ANY hop counts one error (attributed to its
+    phase in `phase_errors`); `failed_shows` counts sessions whose final
+    verdict was False — a correctness alarm, since every minted
+    credential must show-verify.
+
+    The engine must already be started; callers own lifecycle."""
+    if not pool:
+        raise ValueError("session loadgen pool must be non-empty")
+    rng = rng if rng is not None else random.Random(0x5E5510)
+    lock = threading.Lock()
+    session_lat = []
+    phase_lat = {p: [] for p in SESSION_PHASES}
+    phase_errors = {p: 0 for p in SESSION_PHASES}
+    counts = {
+        "started": 0,
+        "completed": 0,
+        "rejected": 0,
+        "shed": 0,
+        "failed_shows": 0,
+    }
+    stages0 = _stage_totals()
+    t0 = clock()
+    t_end = t0 + duration_s
+
+    def run_one_session():
+        messages, elg_pk, elg_sk = pool[rng.randrange(len(pool))]
+        t_start = clock()
+        with lock:
+            counts["started"] += 1
+        phase = SESSION_PHASES[0]
+        try:
+            t_p = clock()
+            sig_req, _rand = engine.submit_prepare(
+                messages, elg_pk, lane=lane
+            ).result(result_timeout)
+            with lock:
+                phase_lat["prepare"].append(clock() - t_p)
+            phase = "mint"
+            t_p = clock()
+            cred = engine.submit_mint(
+                sig_req, messages, elg_sk, lane=lane
+            ).result(result_timeout)
+            with lock:
+                phase_lat["mint"].append(clock() - t_p)
+            phase = "show_prove"
+            t_p = clock()
+            proof, challenge, revealed = engine.submit_show_prove(
+                cred, messages, lane=lane
+            ).result(result_timeout)
+            with lock:
+                phase_lat["show_prove"].append(clock() - t_p)
+            phase = "show_verify"
+            t_p = clock()
+            verdict = engine.submit_show_verify(
+                proof, revealed, challenge, lane=lane
+            ).result(result_timeout)
+            with lock:
+                phase_lat["show_verify"].append(clock() - t_p)
+        except ServiceOverloadedError:
+            with lock:
+                counts["rejected"] += 1
+            return
+        except ServiceBrownoutError:
+            with lock:
+                counts["shed"] += 1
+            return
+        except ServiceClosedError:
+            return
+        except Exception:
+            with lock:
+                phase_errors[phase] += 1
+            return
+        with lock:
+            counts["completed"] += 1
+            session_lat.append(clock() - t_start)
+            if not verdict:
+                counts["failed_shows"] += 1
+
+    def client():
+        while clock() < t_end:
+            run_one_session()
+
+    threads = [
+        threading.Thread(target=client, name="session-loadgen-%d" % i)
+        for i in range(concurrency)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    elapsed = max(clock() - t0, 1e-9)
+    per_program = {}
+    for phase, lats in phase_lat.items():
+        per_program[phase] = {
+            "completed": len(lats),
+            "errors": phase_errors[phase],
+            "goodput_per_s": round(len(lats) / elapsed, 2),
+            "latency_s": _percentiles(lats),
+        }
+    return {
+        "arrival": "closed",
+        "duration_s": round(elapsed, 3),
+        "concurrency": concurrency,
+        "sessions_started": counts["started"],
+        "sessions_completed": counts["completed"],
+        "rejected": counts["rejected"],
+        "shed": counts["shed"],
+        "errors": sum(phase_errors.values()),
+        "failed_shows": counts["failed_shows"],
+        "sessions_per_s": round(counts["completed"] / elapsed, 2),
+        "session_latency_s": _percentiles(session_lat),
+        "per_program": per_program,
+        "stage_breakdown_s": _stage_delta(stages0, _stage_totals()),
+    }
+
+
 def _issue_report(t, issue_service, before_counts, elapsed):
     """The mixed-workload report's issuance section: client-observed
     outcomes plus the quorum-health counter deltas over the run. Every

@@ -110,6 +110,7 @@ class Request:
         "span",
         "queue_span",
         "redispatches",
+        "program",
     )
 
     def __init__(self, sig, messages, lane, max_wait_ms, t_submit):
@@ -121,6 +122,10 @@ class Request:
         self.max_wait_ms = max_wait_ms
         self.t_submit = t_submit
         self.future = ServeFuture()
+        # which engine program this request belongs to (stamped by the
+        # owning queue at admission; None for a bare Request, which the
+        # engine resolves to its primary program)
+        self.program = None
         # times this request was re-placed after its executor crashed or
         # hung (serve/service.py redistribution); capped by the service's
         # max_redispatch so a poisonous batch can't serially kill the pool
@@ -143,17 +148,27 @@ class RequestQueue:
     and the batcher. All waiting/flush policy lives in serve/batcher.py;
     this class owns admission, ordering, and close semantics."""
 
-    def __init__(self, max_depth=1024, clock=time.monotonic, metric_ns="serve"):
+    def __init__(
+        self,
+        max_depth=1024,
+        clock=time.monotonic,
+        metric_ns="serve",
+        program=None,
+    ):
         """metric_ns: the counter namespace admissions report under —
         "serve" (verify service, the historical names) or "issue" (the
         threshold-issuance service, coconut_tpu/issue/). The queue itself
         is payload-agnostic: `sig` is whatever the owning service coalesces
-        (a credential to verify, or an issuance order to blind-sign)."""
+        (a credential to verify, or an issuance order to blind-sign).
+        program: the engine program name stamped onto every admitted
+        request (and carried by overload rejections) so heterogeneous
+        lanes sharing one executor pool stay attributable."""
         if max_depth < 1:
             raise ValueError("max_depth must be >= 1 (got %r)" % (max_depth,))
         self.max_depth = max_depth
         self.clock = clock
         self.metric_ns = metric_ns
+        self.program = program
         self.cond = threading.Condition()
         self.closed = False
         self._lanes = {lane: deque() for lane in LANES}
@@ -167,6 +182,7 @@ class RequestQueue:
         if max_wait_ms is None:
             max_wait_ms = DEFAULT_MAX_WAIT_MS
         req = Request(sig, messages, lane, max_wait_ms, self.clock())
+        req.program = self.program
         with self.cond:
             if self.closed:
                 raise ServiceClosedError(
@@ -175,7 +191,12 @@ class RequestQueue:
             depth = self._depth_locked()
             if depth >= self.max_depth:
                 metrics.count("%s_rejected" % self.metric_ns)
-                raise ServiceOverloadedError(depth, self.max_depth)
+                raise ServiceOverloadedError(
+                    depth,
+                    self.max_depth,
+                    program=self.program,
+                    retry_after_s=max_wait_ms / 1000.0,
+                )
             req.span = otrace.start_span(
                 "request", root=True, lane=lane, max_wait_ms=max_wait_ms
             )

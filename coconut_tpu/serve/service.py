@@ -1,370 +1,165 @@
-"""The online credential service: a mesh-native dispatcher pool wiring the
-deadline batcher into the existing offline machinery.
+"""The online credential-verify service: a thin *program* registered on
+the unified execution engine (coconut_tpu/engine, PR 12).
 
-Topology (PR 6): a PLACER thread owns coalescing and placement; a pool of
-per-device EXECUTOR threads owns dispatch. The placer pops coalesced
-batches off the request queue (serve/batcher.py) and hands each to an
-executor; every executor runs the same launch/settle async double-buffer
-the single-supervisor service ran — so encode for batch i+1 overlaps
-device compute for batch i PER DEVICE — through the SAME seams the
-offline stream uses, and demuxes per-credential verdicts back onto the
-originating futures.
+Everything structural that used to live here — the per-device executor
+pool, the placer thread, adaptive single/mesh placement, the health
+registry (circuit breakers, hung-dispatch watchdog, probation revival,
+redistribution with hop caps), brownout admission, and the generic
+launch/settle batch path — is now the engine's (engine/core.py,
+engine/executor.py). What REMAINS here is exactly the verify phase's
+crypto and policy:
 
-Placement is adaptive, decided per coalesced batch:
+  VerifyProgram     the engine program: encode (identity-lane padding in
+                    per_credential mode), dispatch (stream._dispatchers
+                    through the backends' *_async seams, optionally
+                    device-pinned), demux (per-credential bits, or the
+                    grouped accept/bisect ladder with dead-lettered
+                    culprits), the retry/fallback policy, and the
+                    mesh-capable placement contract.
+  CredentialService an ExecutionEngine subclass that registers ONE
+                    VerifyProgram, builds the device pool + optional
+                    mesh lane from its constructor knobs, and keeps the
+                    historical public API (`submit`, `drain`,
+                    `shutdown`, `health_tick`, context manager) and
+                    every historical metric/span name.
 
-  - LEAST-LOADED SINGLE DEVICE (default): the batch goes whole to the
-    executor with the fewest unsettled request lanes — the latency path:
-    no cross-chip collective, one device round trip.
-  - SHARDED ACROSS THE MESH: a batch of at least `sharded_min_lanes`
-    containing no interactive requests routes through the dp-sharded
-    mesh program (tpu/shard.py, via stream._dispatchers(mesh=...)) — the
-    throughput path for bulk traffic, where one batch's work spans every
-    chip. Batch size and lane decide; interactive requests never pay a
-    collective on their latency path.
-
-  Both paths keep jit shapes cache-hot through the identity-lane padding
-  convention: per-credential batches pad to max_batch (pad_partial),
-  grouped mesh batches pad to one fixed power-of-two shape.
-
-Backpressure: each executor accepts at most one unsettled batch (two
-when its dispatch is async — the in-flight one plus the one being
-encoded), and the batcher's `ready` gate holds any further backlog IN
-the request queue, where bounded-depth admission control can see and
-refuse it. Without the gate, a pool would silently convert overload into
-unbounded executor inboxes.
-
-Everything fault- and perf-related is reused, not reinvented:
-
-  - PR-2 supervision: each batch's dispatch+readback cycle runs under
-    `retry.call_with_retry` (bounded backoff, deterministic jitter), then
-    degrades to `fallback_backend`; in grouped mode a rejected batch is
-    bisected with `stream._make_bisector` — so ONE forged credential
-    fails ITS future (and lands in the dead-letter JSONL) while every
-    cohabiting request resolves valid. Containment is per batch, hence
-    per device: a fault on one device's batch never stalls the others'
-    pipelines.
-  - PR-3 pipelining: dispatch goes through the backends' `*_async` seams
-    (probed by `stream._dispatchers`, optionally pinned to one jax
-    device), the encode rides the static-operand cache.
-
-SELF-HEALING (this layer's own fault story, serve/health.py): the pool
-contains executor-level failures the way PR-2 contains batch-level ones.
-
-  - CRASH CONTAINMENT: an executor-loop crash (a BaseException escaping
-    the per-batch containment in _launch/_settle) quarantines ONLY that
-    executor: its unsettled batches — the in-flight one plus its inbox —
-    are REDISTRIBUTED to surviving executors through the same _route/
-    _place seams, where the PR-2 retry/bisection ladder still applies.
-    Service-wide poison (`_crash`) happens only when the LAST executor
-    dies, so no future ever dangles either way.
-  - HUNG-DISPATCH WATCHDOG: every dispatch is deadline-tracked
-    (health.Watchdog, k x EMA budget per executor); a dispatch that never
-    returns — the failure mode PR-2's retry can't see — is expired by the
-    watchdog thread: the stuck worker is ABANDONED (generation bump; its
-    eventual return is discarded by the stale-settle guard), the executor
-    quarantined, the hung batch redistributed.
-  - QUARANTINE -> PROBATION -> HEALTHY: a per-executor circuit breaker
-    (health.ExecutorHealth) also opens on consecutive batch failures;
-    after a cooldown the executor re-enters via half-open PROBATION (one
-    probe batch at a time, respawning an abandoned worker) and closes
-    back to HEALTHY on consecutive probe successes — a flapping device
-    backs off exponentially instead of oscillating.
-  - BROWNOUT: with capacity degraded or the queue near its bound, bulk
-    submissions are shed with the typed, retriable ServiceBrownoutError
-    (retry-after hint included) while interactive traffic stays live —
-    graded degradation between "fully up" and the hard admission bound.
-
-Request path: `submit()` -> brownout check -> admission control (bounded
-queue, typed rejection) -> coalesce (full batch or oldest deadline) ->
-place (least-loaded ADMISSIBLE device, or mesh-sharded) -> identity-pad
-to the cache-hot shape -> dispatch under retry/fallback -> demux ->
-future resolves. Per-request latency lands in the "serve_latency_s"
-histogram; per-device dispatch/request counters, busy-second timers,
-health gauges, placement/quarantine/watchdog/shed counters, and
-queue-depth/load gauges land in `metrics.snapshot()` (see metrics.py).
-
-Tracing (coconut_tpu/obs, COCONUT_TRACE=1): each coalesced batch is a
-trace of its own — root "batch" span (stamped with the DEVICE id and the
-PLACEMENT decision) with "coalesce", "dispatch" (device-stamped),
-"device" and "demux" children; retry attempts, fallback switches, and
-bisection splits land as events on the active span. The batch span links
-its member requests' trace_ids (and each request span carries
-`batch_trace` back); culprits isolated by bisection get a "dead_letter"
-event on THEIR request span — so a dead-lettered request's span tree
-names the device that verified (and rejected) it. Health transitions are
-instant "health" spans; watchdog expiries land as "watchdog_timeout"
-events on the hung batch's span, redistribution as "redistributed"
-events on each affected request's span.
-
-Lifecycle: `start()` launches the executors, the placer, and the
-watchdog thread; `drain()` closes intake, flushes and settles everything
-in flight, and joins all threads under ONE shared deadline (`timeout` is
-a total budget, not per-thread) — every accepted future is resolved.
-`shutdown(drain=False)` instead fails still-QUEUED requests with
-`ServiceClosedError` (batches already placed on executors still settle).
-A placer crash — or the death of the last executor — sweeps all
-queued+in-flight futures with the crash exception — no caller ever hangs
-on a dropped future. The context-manager form
-(`with CredentialService(...) as svc:`) is start()/drain().
+The behavior catalog — placement policy, backpressure, PR-2 containment
+(retry -> fallback -> bisection -> dead letter), PR-3 pipelining, PR-9
+self-healing (crash containment, watchdog, quarantine/probation,
+brownout), lifecycle semantics — is unchanged from PR 6-9; see the
+engine package docstrings for the mechanism and serve/health.py for the
+policies. Request path: `submit()` -> brownout check -> admission
+control -> coalesce -> place -> identity-pad -> dispatch under
+retry/fallback -> demux -> future resolves. Metrics keep their PR-6/9
+names ("serve_latency_s", "serve_dev*", "serve_placed_*", health gauges,
+shed counters); batch spans gain a `program="verify"` attribute.
 """
 
-import threading
 import time
-from collections import deque
 
-from .. import metrics
-from ..errors import ServiceBrownoutError, ServiceClosedError
-from ..obs import trace as otrace
-from ..retry import RetryPolicy, call_with_retry, note_attempt
+from ..engine.core import ExecutionEngine, _next_pow2, _remaining  # noqa: F401
+from ..engine.executor import Executor
+from ..engine.program import Program
+from ..retry import RetryPolicy
 from ..stream import _dispatchers, _fallback_dispatcher, _make_bisector
-from . import health as _health
-from .batcher import Batcher, demux, fail_all, pad_batch
-from .queue import RequestQueue
+from .batcher import demux, fail_all, pad_batch
+
+#: historical name — tests (and PR-8 era code) construct the executor
+#: under this alias; the implementation moved to engine/executor.py
+_DeviceExecutor = Executor
 
 
-def _next_pow2(n):
-    """Smallest power of two >= n (and >= 2) — the grouped kernel's batch
-    shape convention (tpu/backend.py's Bp)."""
-    return 1 << max(1, (n - 1).bit_length())
+class VerifyProgram(Program):
+    """The show-verify-credential phase as an engine program: coalesced
+    credential batches, identity-lane padding, grouped bisection."""
 
-
-def _remaining(deadline):
-    """Seconds left until `deadline` on the REAL clock (thread joins are
-    wall-time waits even under an injected fake clock); None = no bound."""
-    if deadline is None:
-        return None
-    return max(0.0, deadline - time.monotonic())
-
-
-class _DeviceExecutor:
-    """One device's serving loop: an inbox worker thread running the
-    launch/settle async double-buffer for ITS device.
-
-    Load accounting (`load()`: unsettled request lanes) drives the
-    placer's least-loaded pick; `can_accept()` bounds unsettled batches
-    to 1 (sync dispatch) or 2 (async: one in flight + one being encoded),
-    which is the pool-shaped generalization of the old single supervisor's
-    double buffer — anything beyond that stays in the request queue where
-    admission control is. Settling kicks the request queue so a
-    capacity-gated placer re-checks.
-
-    GENERATIONS: the worker thread carries the generation it was spawned
-    under. `abandon()` (crash containment, watchdog timeout) bumps the
-    generation and drops the thread reference — the old worker, possibly
-    still stuck inside a hung dispatch, becomes STALE: `_next`/`_finish`
-    ignore it, and the service's stale-settle guard discards whatever it
-    eventually returns. `start()` can then respawn a FRESH worker for the
-    probation probe."""
+    name = "verify"
+    metric_ns = "serve"
+    slo_class = "standard"  # the caller's lane decides shedding
+    pad_convention = "identity-credential"
+    supports_mesh = True
 
     def __init__(
         self,
-        service,
-        index,
-        label=None,
-        device=None,
-        dispatch=None,
-        is_async=False,
-        placement="single",
+        backend,
+        vk,
+        params,
+        mode,
+        max_batch,
+        max_wait_ms,
+        max_depth,
+        pad_partial,
+        retry_policy,
+        fallback_dispatch,
+        bisector,
     ):
-        self.service = service
-        self.index = index
-        self.label = str(index) if label is None else label
-        self.device = device
-        self.dispatch = dispatch
-        self.is_async = is_async
-        self.placement = placement  # "single" | "sharded"
-        self.busy_timer = "serve_dev%s_busy_s" % self.label
-        self._cond = threading.Condition()
-        self._inbox = deque()
-        self._load = 0  # unsettled request lanes (queued + in flight)
-        self._batches_out = 0  # unsettled batches (capacity bound)
-        self._closed = False
-        self._gen = 0
-        self._thread = None
+        self.backend = backend
+        self.vk = vk
+        self.params = params
+        self.mode = mode
+        self.max_batch = max_batch
+        self.max_wait_ms = max_wait_ms
+        self.max_depth = max_depth
+        self.pad_partial = pad_partial
+        self.retry_policy = retry_policy
+        self._fallback_dispatch = fallback_dispatch
+        self._bisector = bisector
 
-    # -- placer side ---------------------------------------------------------
+    # -- engine hooks --------------------------------------------------------
 
-    def load(self):
-        with self._cond:
-            return self._load
+    def make_dispatch(self, device=None):
+        dispatch, _, is_async = _dispatchers(
+            self.backend, self.mode, device=device
+        )
+        return dispatch, is_async
 
-    def batches_out(self):
-        with self._cond:
-            return self._batches_out
+    def assemble(self, requests, bspan):
+        if self.pad_partial:
+            sigs, messages_list, n_pad = pad_batch(requests, self.max_batch)
+            bspan.set(n_pad=n_pad)
+        else:
+            sigs = [r.sig for r in requests]
+            messages_list = [r.messages for r in requests]
+        return sigs, messages_list
 
-    def can_accept(self):
-        with self._cond:
-            return self._batches_out < (2 if self.is_async else 1)
+    def run_dispatch(self, executor, sigs, messages_list):
+        # the bare `.dispatch` attribute, not the program registry: the
+        # verify program IS every pool executor's primary dispatch (and
+        # tests stub `ex.dispatch` directly)
+        return executor.dispatch(sigs, messages_list, self.vk, self.params)
 
-    def submit_batch(self, requests):
-        with self._cond:
-            self._inbox.append(requests)
-            self._load += len(requests)
-            self._batches_out += 1
-            load = self._load
-            self._cond.notify_all()
-        metrics.set_gauge("serve_dev%s_load" % self.label, load)
+    def make_fallback(self, sigs, messages_list):
+        if self._fallback_dispatch is None:
+            return None
+        return lambda: self._fallback_dispatch(
+            sigs, messages_list, self.vk, self.params
+        )()
 
-    # -- lifecycle -----------------------------------------------------------
-
-    def start(self):
-        """Spawn the worker thread — a no-op while one is running (or
-        after close()). Also the PROBATION revival path: after abandon()
-        the thread slot is empty, so start() spawns a fresh worker under
-        the new generation."""
-        with self._cond:
-            if self._closed or self._thread is not None:
-                return
-            gen = self._gen
-            self._thread = threading.Thread(
-                target=self._run,
-                args=(gen,),
-                name="coconut-serve-dev%s.g%d" % (self.label, gen),
-                daemon=True,
+    def demux(self, requests, result, sigs, messages_list, seq, attempts,
+              bspan):
+        clock = self.engine.clock
+        if self.mode == "per_credential":
+            demux(requests, result[: len(requests)], clock=clock)
+            bspan.end(result="demuxed")
+            return
+        if result:
+            demux(requests, [True] * len(requests), clock=clock)
+            bspan.end(result="accepted")
+            return
+        # grouped rejection: recover per-request verdicts by bisection so
+        # one forged credential fails only its own future; culprit
+        # dead-letter lines carry the CULPRIT request's trace_id (not the
+        # batch's), so an operator greps straight from a JSONL line to
+        # the request's span tree — which names the device via its batch
+        # span
+        culprits = (
+            set(
+                self._bisector(
+                    sigs,
+                    messages_list,
+                    seq,
+                    attempts,
+                    trace_ids=[r.future.trace_id for r in requests],
+                )
             )
-            thread = self._thread
-        thread.start()
+            if self._bisector is not None
+            else set(range(len(requests)))
+        )
+        for i in culprits:
+            if i < len(requests):
+                requests[i].span.event("dead_letter", batch_seq=seq)
+        demux(
+            requests,
+            [i not in culprits for i in range(len(requests))],
+            clock=clock,
+        )
+        bspan.end(result="bisected", n_culprits=len(culprits))
 
-    def close(self):
-        """Stop accepting; the loop still settles its inbox, then exits."""
-        with self._cond:
-            self._closed = True
-            self._cond.notify_all()
-
-    def join(self, timeout=None):
-        if self._thread is None:
-            return True
-        self._thread.join(timeout)
-        return not self._thread.is_alive()
-
-    def has_worker(self):
-        """A live (non-abandoned) worker thread exists — the executor can
-        still settle batches, even quarantined."""
-        with self._cond:
-            return self._thread is not None and self._thread.is_alive()
-
-    def is_current(self, gen):
-        with self._cond:
-            return gen == self._gen
-
-    def abandon(self):
-        """Crash/hang containment: bump the generation (the old worker —
-        possibly stuck inside a dispatch that will never return — becomes
-        stale), sweep the inbox, zero the load so the placer never routes
-        here until a probation probe revives it. Returns the swept
-        batches; the CALLER owns redistributing them. Unlike poison(),
-        the executor is NOT closed: start() can respawn it."""
-        with self._cond:
-            self._gen += 1
-            self._thread = None
-            swept = list(self._inbox)
-            self._inbox.clear()
-            self._load = 0
-            self._batches_out = 0
-            self._cond.notify_all()
-        metrics.set_gauge("serve_dev%s_load" % self.label, 0)
-        return swept
-
-    def sweep_inbox(self):
-        """Pull every QUEUED (not yet launched) batch back out — the soft
-        quarantine path: the worker stays alive to settle what's in
-        flight, but its backlog moves to survivors."""
-        with self._cond:
-            swept = list(self._inbox)
-            self._inbox.clear()
-            for batch in swept:
-                self._load = max(0, self._load - len(batch))
-                self._batches_out = max(0, self._batches_out - 1)
-            load = self._load
-            self._cond.notify_all()
-        metrics.set_gauge("serve_dev%s_load" % self.label, load)
-        return swept
-
-    def poison(self, exc):
-        """Crash sweep: refuse everything still queued on this device."""
-        with self._cond:
-            self._closed = True
-            swept = list(self._inbox)
-            self._inbox.clear()
-            self._load = 0
-            self._batches_out = 0
-            self._cond.notify_all()
-        for batch in swept:
-            fail_all(batch, exc)
-
-    # -- worker loop ---------------------------------------------------------
-
-    def _next(self, gen, block):
-        with self._cond:
-            while True:
-                if self._gen != gen:
-                    return None  # abandoned: this worker is stale — exit
-                if self._inbox:
-                    return self._inbox.popleft()
-                if self._closed or not block:
-                    return None
-                self._cond.wait()
-
-    def _finish(self, gen, n_lanes):
-        with self._cond:
-            if self._gen != gen:
-                return  # stale worker: accounting belongs to the new gen
-            self._load = max(0, self._load - n_lanes)
-            self._batches_out = max(0, self._batches_out - 1)
-            load = self._load
-        metrics.set_gauge("serve_dev%s_load" % self.label, load)
-        # capacity freed: wake a placer gated on ready()
-        self.service._queue.kick()
-
-    def _run(self, gen):
-        svc = self.service
-        pending = None  # launched, unsettled (async double-buffer slot)
-        current = None  # popped from the inbox, not yet fully handled
-        try:
-            while True:
-                current = self._next(gen, block=pending is None)
-                if current is not None:
-                    launched = svc._launch(current, self)
-                    if pending is not None:
-                        svc._settle(*pending)
-                        self._finish(gen, len(pending[1]))
-                        pending = None
-                    if self.is_async:
-                        # double-buffer: leave this batch in flight and go
-                        # take the next while the device runs
-                        pending = launched
-                    else:
-                        svc._settle(*launched)
-                        self._finish(gen, len(current))
-                    current = None
-                    continue
-                if pending is not None:
-                    # nothing ready to overlap with: settle the in-flight
-                    # batch now instead of holding its latency hostage
-                    svc._settle(*pending)
-                    self._finish(gen, len(pending[1]))
-                    pending = None
-                    continue
-                # closed/abandoned and inbox empty: exit
-                return
-        except BaseException as e:  # loop-level crash (a code bug escaping
-            # the per-batch containment in _launch/_settle): hand THIS
-            # executor's unsettled batches — in-flight and mid-launch — to
-            # the service for quarantine + redistribution; the pool
-            # survives unless this was the last executor
-            batches = []
-            spans = []
-            if pending is not None:
-                batches.append(pending[1])
-                spans.append(pending[6])
-            if current is not None and (
-                pending is None or current is not pending[1]
-            ):
-                batches.append(current)
-            svc._executor_failed(self, e, batches, spans, gen)
+    def fail_batch(self, requests, exc):
+        fail_all(requests, exc)
 
 
-class CredentialService:
+class CredentialService(ExecutionEngine):
     """Dynamic-batching verify service over any verify-capable backend.
 
     backend / fallback_backend: instances or registry names ("python",
@@ -382,7 +177,7 @@ class CredentialService:
     backends without device placement), or a list of jax devices (one
     executor pinned to each). `mesh` adds the dp-sharded mesh dispatch
     lane; batches of >= `sharded_min_lanes` (default max_batch) with no
-    interactive requests route through it (see _route).
+    interactive requests route through it (see engine._route).
 
     Self-healing knobs (serve/health.py): `health_policy` configures the
     per-executor circuit breaker, `watchdog` the hung-dispatch deadline
@@ -425,6 +220,21 @@ class CredentialService:
             fallback_backend = get_backend(fallback_backend)
         if mode not in ("per_credential", "grouped"):
             raise ValueError("unknown serve mode %r" % (mode,))
+
+        super().__init__(
+            name="coconut-serve",
+            metric_ns="serve",
+            clock=clock,
+            mesh=mesh,
+            sharded_min_lanes=(
+                max_batch if sharded_min_lanes is None else sharded_min_lanes
+            ),
+            health_policy=health_policy,
+            watchdog=watchdog,
+            watchdog_interval_s=watchdog_interval_s,
+            brownout=brownout,
+        )
+
         self.backend = backend
         self.vk = vk
         self.params = params
@@ -432,52 +242,6 @@ class CredentialService:
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
         self.pad_partial = pad_partial and mode == "per_credential"
-        self.clock = clock
-
-        if devices is None:
-            device_list = [None]
-        elif isinstance(devices, int):
-            if devices < 1:
-                raise ValueError("devices must be >= 1 (got %r)" % (devices,))
-            device_list = [None] * devices
-        else:
-            device_list = list(devices)
-            if not device_list:
-                raise ValueError("devices list must be non-empty")
-        self._executors = []
-        for i, dev in enumerate(device_list):
-            dispatch, _, is_async = _dispatchers(backend, mode, device=dev)
-            self._executors.append(
-                _DeviceExecutor(
-                    self, i, device=dev, dispatch=dispatch, is_async=is_async
-                )
-            )
-        self._is_async = self._executors[0].is_async
-
-        self.mesh = mesh
-        self.sharded_min_lanes = (
-            max_batch if sharded_min_lanes is None else sharded_min_lanes
-        )
-        self._mesh_executor = None
-        if mesh is not None:
-            pad_to = None
-            if mode == "grouped" and "dp" in mesh.shape:
-                # ONE fixed grouped shape across all occupancy levels:
-                # the sharded encode's own floor (2*ndp) or the service's
-                # max batch rounded to the kernel's power-of-two, whichever
-                # is larger — varying coalesced sizes never recompile
-                pad_to = max(2 * mesh.shape["dp"], _next_pow2(max_batch))
-            mesh_dispatch, _, _ = _dispatchers(
-                backend, mode, mesh=mesh, mesh_pad_to=pad_to
-            )
-            self._mesh_executor = _DeviceExecutor(
-                self,
-                len(self._executors),
-                label="mesh",
-                dispatch=mesh_dispatch,
-                is_async=True,
-                placement="sharded",
-            )
 
         self._fallback_dispatch = (
             _fallback_dispatcher(fallback_backend, mode)
@@ -505,40 +269,58 @@ class CredentialService:
                 params,
                 retry_policy,
                 dead_letter_path,
+                program="verify",
             )
             if mode == "grouped"
             else None
         )
-        self._queue = RequestQueue(max_depth=max_depth, clock=clock)
-        self._batcher = Batcher(self._queue, max_batch, clock=clock)
-        self._thread = None
-        self._seq_lock = threading.Lock()
-        self._batch_seq = 0  # dead-letter batch ids + retry jitter keys
-        self._crashed = None
 
-        # self-healing surfaces (serve/health.py)
-        self.health_policy = (
-            health_policy if health_policy is not None else _health.HealthPolicy()
+        self._program = VerifyProgram(
+            backend,
+            vk,
+            params,
+            mode,
+            max_batch,
+            max_wait_ms,
+            max_depth,
+            self.pad_partial,
+            retry_policy,
+            self._fallback_dispatch,
+            self._bisector,
         )
-        self._watchdog = (
-            watchdog if watchdog is not None else _health.Watchdog(clock=clock)
-        )
-        self._watchdog_interval_s = watchdog_interval_s
-        self._brownout = (
-            brownout if brownout is not None else _health.BrownoutPolicy()
-        )
-        all_ex = self._all_executors()
-        self._healths = {}
-        for ex in all_ex:
-            self._health_of(ex.label)
-        self.max_redispatch = (
-            max(1, len(all_ex) - 1) if max_redispatch is None else max_redispatch
-        )
-        self._wd_stop = threading.Event()
-        self._wd_thread = None
-        for ex in all_ex:
-            metrics.set_gauge("serve_dev%s_health" % ex.label, _health.HEALTHY)
-        self._refresh_health_gauges()
+        self.register(self._program)
+
+        # the device pool: one executor per device, the verify program's
+        # device-pinned dispatch as each executor's primary closure
+        if devices is None:
+            device_list = [None]
+        elif isinstance(devices, int):
+            if devices < 1:
+                raise ValueError("devices must be >= 1 (got %r)" % (devices,))
+            device_list = [None] * devices
+        else:
+            device_list = list(devices)
+            if not device_list:
+                raise ValueError("devices list must be non-empty")
+        for dev in device_list:
+            dispatch, is_async = self._program.make_dispatch(device=dev)
+            self._add_executor(device=dev, dispatch=dispatch,
+                               is_async=is_async)
+
+        if mesh is not None:
+            pad_to = None
+            if mode == "grouped" and "dp" in mesh.shape:
+                # ONE fixed grouped shape across all occupancy levels:
+                # the sharded encode's own floor (2*ndp) or the service's
+                # max batch rounded to the kernel's power-of-two, whichever
+                # is larger — varying coalesced sizes never recompile
+                pad_to = max(2 * mesh.shape["dp"], _next_pow2(max_batch))
+            mesh_dispatch, _, _ = _dispatchers(
+                backend, mode, mesh=mesh, mesh_pad_to=pad_to
+            )
+            self._set_mesh_executor(mesh_dispatch)
+
+        self._finalize_pool(max_redispatch)
 
     # -- client side ---------------------------------------------------------
 
@@ -548,595 +330,6 @@ class CredentialService:
         graded load-shedding refuses this lane (retriable, carries a
         retry-after hint), ServiceOverloadedError at the admission bound,
         ServiceClosedError after drain/shutdown."""
-        if self._crashed is not None:
-            raise ServiceClosedError(
-                "service supervisor crashed: %r" % (self._crashed,)
-            )
-        depth = self._queue.depth()
-        capacity = self._capacity_fraction()
-        active, retry_after = self._brownout.check(
-            lane, depth, self._queue.max_depth, capacity
+        return self.submit_request(
+            "verify", sig, messages, lane=lane, max_wait_ms=max_wait_ms
         )
-        metrics.set_gauge("serve_brownout", 1 if active else 0)
-        if retry_after is not None:
-            metrics.count("serve_shed_bulk")
-            raise ServiceBrownoutError(
-                lane, retry_after, depth=depth, capacity_fraction=capacity
-            )
-        return self._queue.submit(
-            sig,
-            messages,
-            lane=lane,
-            max_wait_ms=(
-                self.max_wait_ms if max_wait_ms is None else max_wait_ms
-            ),
-        )
-
-    def depth(self):
-        return self._queue.depth()
-
-    def kick(self):
-        """Wake the placer to re-read the clock (fake-clock tests)."""
-        self._queue.kick()
-
-    # -- lifecycle -----------------------------------------------------------
-
-    def _all_executors(self):
-        if self._mesh_executor is not None:
-            return self._executors + [self._mesh_executor]
-        return list(self._executors)
-
-    def start(self):
-        if self._thread is None:
-            for ex in self._all_executors():
-                ex.start()
-            self._thread = threading.Thread(
-                target=self._run, name="coconut-serve", daemon=True
-            )
-            self._thread.start()
-            if self._watchdog_interval_s is not None:
-                self._wd_thread = threading.Thread(
-                    target=self._watchdog_loop,
-                    name="coconut-serve-watchdog",
-                    daemon=True,
-                )
-                self._wd_thread.start()
-        return self
-
-    def _close_pool(self, deadline, ok):
-        """Join the placer's executors after intake+placement ended; every
-        inbox batch still settles before an executor exits. `deadline` is
-        the drain/shutdown call's SINGLE shared deadline — each join gets
-        whatever budget remains, not a fresh per-thread timeout."""
-        for ex in self._all_executors():
-            ex.close()
-        for ex in self._all_executors():
-            ok = ex.join(_remaining(deadline)) and ok
-        # the watchdog goes LAST: it can still expire a hung dispatch
-        # (and redistribute its batch) while the pool drains
-        ok = self._stop_watchdog(deadline) and ok
-        return ok
-
-    def _stop_watchdog(self, deadline):
-        thread = self._wd_thread
-        if thread is None:
-            return True
-        self._wd_stop.set()
-        thread.join(_remaining(deadline))
-        return not thread.is_alive()
-
-    def drain(self, timeout=None):
-        """Close intake, settle every accepted request, join the placer
-        and the executor pool. Every accepted future is resolved on return
-        (True iff all threads exited within `timeout` — ONE deadline
-        shared across every join, not a per-thread allowance)."""
-        deadline = None if timeout is None else time.monotonic() + timeout
-        self._queue.close()
-        if self._thread is None:
-            # never started: nothing will settle the queue — fail loudly
-            fail_all(
-                self._queue.drain_pending(),
-                ServiceClosedError("service drained before start()"),
-                counter="serve_cancelled",
-            )
-            return True
-        self._thread.join(_remaining(deadline))
-        return self._close_pool(deadline, not self._thread.is_alive())
-
-    def shutdown(self, drain=True, timeout=None):
-        """drain=True: alias for drain(). drain=False: refuse the queued
-        backlog (futures fail with ServiceClosedError) but still settle
-        work already placed on executors, then join — `timeout` again one
-        shared deadline across all joins."""
-        if drain:
-            return self.drain(timeout)
-        deadline = None if timeout is None else time.monotonic() + timeout
-        self._queue.close()
-        fail_all(
-            self._queue.drain_pending(),
-            ServiceClosedError("service shut down before this request ran"),
-            counter="serve_cancelled",
-        )
-        if self._thread is not None:
-            self._thread.join(_remaining(deadline))
-            return self._close_pool(deadline, not self._thread.is_alive())
-        return self._stop_watchdog(deadline)
-
-    def __enter__(self):
-        return self.start()
-
-    def __exit__(self, exc_type, exc, tb):
-        self.drain()
-        return False
-
-    # -- health (serve/health.py integration) --------------------------------
-
-    def _health_of(self, label):
-        """The breaker for `label`, created on first sight (executors can
-        be injected post-init — tests stub the mesh lane that way)."""
-        h = self._healths.get(label)
-        if h is None:
-            h = self._healths[label] = _health.ExecutorHealth(
-                label, self.health_policy, clock=self.clock
-            )
-        return h
-
-    def _admits(self, ex):
-        """May the placer route NEW work to `ex`? HEALTHY/SUSPECT always;
-        PROBATION only while its half-open probe slot is free (one
-        unsettled probe batch at a time); QUARANTINED never."""
-        h = self._health_of(ex.label)
-        if not h.admissible():
-            return False
-        if h.state == _health.PROBATION and ex.batches_out() > 0:
-            return False
-        return True
-
-    def _capacity_fraction(self):
-        """Fraction of the pool the placer may still route to — the
-        brownout policy's degradation signal."""
-        exs = self._all_executors()
-        ok = sum(1 for ex in exs if self._health_of(ex.label).admissible())
-        return ok / len(exs)
-
-    def _refresh_health_gauges(self):
-        metrics.set_gauge(
-            "serve_healthy_executors",
-            sum(
-                1
-                for ex in self._all_executors()
-                if self._health_of(ex.label).admissible()
-            ),
-        )
-
-    def _note_success(self, executor):
-        change = self._health_of(executor.label).on_success()
-        if change:
-            self._refresh_health_gauges()
-            self._queue.kick()
-
-    def _note_failure(self, executor, exc):
-        """A batch failed past retry+fallback ON this executor: feed the
-        circuit breaker; if that opened it (soft quarantine — the worker
-        itself is alive), move the executor's queued backlog to
-        survivors."""
-        change = self._health_of(executor.label).on_failure(
-            "batch failed past retry+fallback: %s" % type(exc).__name__
-        )
-        if change:
-            self._refresh_health_gauges()
-            self._queue.kick()
-            if change[1] == _health.QUARANTINED:
-                self._redistribute(executor.sweep_inbox(), exc)
-
-    def _executor_failed(self, executor, exc, batches, spans, gen):
-        """Executor-loop crash containment (runs ON the dying worker's
-        thread): quarantine ONLY this executor and hand its unsettled
-        batches to survivors. A stale generation (the watchdog already
-        abandoned this worker and redistributed its work) does nothing."""
-        if not executor.is_current(gen):
-            return
-        metrics.count("serve_executor_crashes")
-        for span in spans:
-            otrace.end_span(span, error=type(exc).__name__)
-        self._health_of(executor.label).on_crash(
-            "executor loop crash: %s" % type(exc).__name__
-        )
-        swept = executor.abandon()
-        self._watchdog.forget_label(executor.label)
-        self._refresh_health_gauges()
-        self._redistribute(list(batches) + swept, exc)
-        self._queue.kick()
-
-    def _redistribute(self, batches, cause):
-        """Re-place a failed executor's unsettled batches through the
-        normal _route/_place seams. Each request's redispatch count is
-        capped (`max_redispatch`): a poisonous batch that kills every
-        executor it lands on fails ITS OWN futures after the cap instead
-        of serially taking down the pool. With NO survivors — the last
-        executor died — the service poisons and every remaining future
-        resolves with the crash exception: none dangle."""
-        batches = [b for b in batches if b]
-        for i, batch in enumerate(batches):
-            survivors = [
-                ex
-                for ex in self._all_executors()
-                if self._health_of(ex.label).admissible() or ex.has_worker()
-            ]
-            if not survivors:
-                self._crash(cause)
-                for rest in batches[i:]:
-                    fail_all(rest, cause)
-                return
-            for r in batch:
-                r.redispatches += 1
-            if max(r.redispatches for r in batch) > self.max_redispatch:
-                metrics.count("serve_redispatch_exhausted")
-                fail_all(batch, cause)
-                continue
-            metrics.count("serve_redistributed_batches")
-            metrics.count("serve_redistributed_requests", len(batch))
-            for r in batch:
-                r.span.event("redistributed", hops=r.redispatches)
-            self._place(batch).submit_batch(batch)
-
-    def health_tick(self, now=None):
-        """One self-healing sweep: expire hung dispatches (abandon the
-        stuck worker, quarantine its executor, redistribute the hung
-        batch) and promote quarantined executors whose cooldown elapsed
-        into half-open PROBATION (respawning abandoned workers). Runs
-        periodically on the watchdog thread in production; fake-clock
-        tests call it directly after advancing time."""
-        if self._crashed is not None:
-            return
-        now = self.clock() if now is None else now
-        expired = self._watchdog.expire(now)
-        from ..errors import TransientBackendError
-
-        by_label = {}
-        for label, seq, requests, span, overdue_s in expired:
-            metrics.count("serve_watchdog_timeouts")
-            if span is not None:
-                span.event(
-                    "watchdog_timeout",
-                    seq=seq,
-                    overdue_s=round(overdue_s, 6),
-                )
-                span.end(error="WatchdogTimeout")
-            by_label.setdefault(label, []).append(requests)
-        for label, hung in by_label.items():
-            ex = next(
-                (x for x in self._all_executors() if x.label == label), None
-            )
-            if ex is None:
-                continue
-            cause = TransientBackendError(
-                "dispatch on executor %s hung past its watchdog budget"
-                % (label,)
-            )
-            self._health_of(label).on_crash("hung dispatch: watchdog timeout")
-            # the worker is STUCK inside the dispatch — abandon it (its
-            # eventual return, if any, is discarded by the stale-settle
-            # guard) and redistribute both the hung batches and the inbox
-            swept = ex.abandon()
-            self._watchdog.forget_label(label)
-            self._refresh_health_gauges()
-            self._redistribute(hung + swept, cause)
-        # half-open promotion: cooldown elapsed -> probation probe window
-        for ex in self._all_executors():
-            if self._health_of(ex.label).try_probation(now):
-                ex.start()  # respawn an abandoned worker; no-op otherwise
-                self._refresh_health_gauges()
-                self._queue.kick()
-        if expired:
-            self._queue.kick()
-
-    def _watchdog_loop(self):
-        while not self._wd_stop.wait(self._watchdog_interval_s):
-            try:
-                self.health_tick()
-            except Exception:
-                # the healer must never become the failure: count and
-                # keep ticking
-                metrics.count("serve_health_tick_errors")
-
-    # -- placement -----------------------------------------------------------
-
-    def _route(self, requests):
-        """The adaptive placement policy: "sharded" (dp-sharded across the
-        mesh) or "single" (whole batch to one device). Batch size and lane
-        decide: only batches of at least `sharded_min_lanes` with NO
-        interactive requests take the mesh — a turnstile request never
-        pays a cross-chip collective on its latency path, while bulk
-        backfill batches get every chip."""
-        if self._mesh_executor is None:
-            return "single"
-        if len(requests) < self.sharded_min_lanes:
-            return "single"
-        if any(r.lane == "interactive" for r in requests):
-            return "single"
-        return "sharded"
-
-    def _has_capacity(self):
-        """ready() gate for the batcher: pop a batch only when some
-        ADMISSIBLE executor can take it, otherwise the backlog stays in
-        the bounded queue where admission control (and the brownout
-        policy) can see and refuse it. Quarantined executors contribute no
-        capacity."""
-        return any(
-            self._admits(ex) and ex.can_accept()
-            for ex in self._all_executors()
-        )
-
-    def _place(self, requests):
-        """Pick the executor for one coalesced batch: the policy's route
-        over the ADMISSIBLE pool, with capacity spill (a full mesh lane
-        falls back to the least-loaded device and vice versa — adaptive,
-        never blocking a popped batch behind one hot executor). Routing a
-        batch to a PROBATION executor is that executor's half-open probe
-        (counted under "serve_probes")."""
-        route = self._route(requests)
-        metrics.count(
-            "serve_placed_sharded" if route == "sharded" else
-            "serve_placed_single"
-        )
-        mesh_ex = self._mesh_executor
-        if mesh_ex is not None and not self._admits(mesh_ex):
-            mesh_ex = None
-        admitted = [ex for ex in self._executors if self._admits(ex)]
-        singles = [ex for ex in admitted if ex.can_accept()]
-        singles.sort(key=lambda ex: (ex.load(), ex.index))
-        if route == "sharded" and mesh_ex is not None:
-            chosen = (
-                mesh_ex
-                if mesh_ex.can_accept()
-                else (singles[0] if singles else mesh_ex)
-            )
-        elif singles:
-            chosen = singles[0]
-        elif mesh_ex is not None and mesh_ex.can_accept():
-            chosen = mesh_ex
-        else:
-            # no admissible executor has capacity: overflow onto the
-            # least-loaded admissible one (capacity is advisory;
-            # quarantine is not) — or, with the WHOLE pool quarantined,
-            # onto any executor whose worker is still alive: settling
-            # behind a sick device beats parking a future behind a probe
-            # that may never come
-            pool = (
-                admitted
-                or [ex for ex in self._all_executors() if ex.has_worker()]
-                or self._executors
-            )
-            chosen = min(pool, key=lambda ex: (ex.load(), ex.index))
-        if (route == "sharded") != (chosen.placement == "sharded"):
-            metrics.count("serve_placed_spill")
-        if self._health_of(chosen.label).state == _health.PROBATION:
-            metrics.count("serve_probes")
-        metrics.set_gauge("serve_queue_depth", self._queue.depth())
-        return chosen
-
-    # -- batch work (runs on executor threads) -------------------------------
-
-    def _launch(self, requests, executor=None):
-        """Assemble + dispatch one coalesced batch NOW on `executor`'s
-        device; return the settle closure state. Mirrors
-        stream.verify_stream's launch(): the first dispatch attempt is
-        consumed eagerly (pipelining), finalize() re-runs the full
-        dispatch+readback cycle under the retry ladder, then the
-        fallback."""
-        if executor is None:
-            executor = self._executors[0]
-        with self._seq_lock:
-            seq = self._batch_seq
-            self._batch_seq += 1
-        metrics.count("serve_dev%s_dispatches" % executor.label)
-        metrics.count("serve_dev%s_requests" % executor.label, len(requests))
-        bspan = otrace.start_span(
-            "batch",
-            root=True,
-            seq=seq,
-            n=len(requests),
-            device=executor.label,
-            placement=executor.placement,
-            members=[r.future.trace_id for r in requests]
-            if otrace.enabled()
-            else None,
-        )
-        for r in requests:
-            # the request->batch join: a request's trace knows which
-            # batch trace (hence which DEVICE) did its device work
-            r.span.set(batch_trace=bspan.trace_id, batch_seq=seq)
-        # deadline-track from BEFORE the first dispatch attempt: a sync
-        # dispatch that hangs never returns from this very call, and the
-        # watchdog is the only thing that can still free its batch
-        self._watchdog.begin(
-            executor.label, seq, requests, span=bspan, now=self.clock()
-        )
-        with otrace.use(bspan), metrics.timer(executor.busy_timer):
-            with otrace.span("coalesce"):
-                if self.pad_partial:
-                    sigs, messages_list, n_pad = pad_batch(
-                        requests, self.max_batch
-                    )
-                    bspan.set(n_pad=n_pad)
-                else:
-                    sigs = [r.sig for r in requests]
-                    messages_list = [r.messages for r in requests]
-            metrics.observe(
-                "serve_batch_wait_s",
-                self.clock() - min(r.t_submit for r in requests),
-            )
-            attempts = []
-            box = [None]
-            permanent = None
-            with otrace.span(
-                "dispatch",
-                backend=type(self.backend).__name__,
-                device=executor.label,
-            ):
-                try:
-                    box[0] = executor.dispatch(
-                        sigs, messages_list, self.vk, self.params
-                    )
-                except self._policy.retryable as e:
-                    note_attempt(attempts, e)
-                    otrace.event(
-                        "attempt_failed",
-                        attempt=len(attempts),
-                        error=type(e).__name__,
-                    )
-                except Exception as e:
-                    # permanent dispatch failure (bad inputs, code bug in
-                    # a sync backend's compute): unlike the offline
-                    # stream — where it aborts the run — the service
-                    # contains it to THIS batch's futures; finalize
-                    # re-raises without burning retries
-                    permanent = e
-                    otrace.event("permanent_failure", error=type(e).__name__)
-
-        def cycle():
-            fin, box[0] = box[0], None
-            if fin is None:
-                fin = executor.dispatch(
-                    sigs, messages_list, self.vk, self.params
-                )
-            return fin()
-
-        fallback = (
-            (
-                lambda: self._fallback_dispatch(
-                    sigs, messages_list, self.vk, self.params
-                )()
-            )
-            if self._fallback_dispatch is not None
-            else None
-        )
-
-        def finalize():
-            if permanent is not None:
-                raise permanent
-            return call_with_retry(
-                cycle,
-                self._policy,
-                key=seq,
-                attempts=attempts,
-                fallback=fallback,
-            )
-
-        return (
-            seq,
-            requests,
-            sigs,
-            messages_list,
-            finalize,
-            attempts,
-            bspan,
-            executor,
-        )
-
-    def _settle(
-        self,
-        seq,
-        requests,
-        sigs,
-        messages_list,
-        finalize,
-        attempts,
-        bspan,
-        executor=None,
-    ):
-        """Block on the batch result and resolve every request's future."""
-        if executor is None:
-            executor = self._executors[0]
-        with otrace.use(bspan), metrics.timer(executor.busy_timer):
-            try:
-                with otrace.span("device", device=executor.label):
-                    result = finalize()
-            except Exception as e:
-                self._watchdog.end(
-                    executor.label, seq, ok=False, now=self.clock()
-                )
-                if requests and all(r.future.done() for r in requests):
-                    # stale settle: the watchdog timed this batch out and
-                    # it was redistributed (and resolved) elsewhere — the
-                    # late failure is nobody's news
-                    bspan.end(result="stale")
-                    return
-                # batch-level failure past retry+fallback: each
-                # cohabiting future gets the exception — never a silent
-                # hang, and never another device's problem
-                fail_all(requests, e)
-                bspan.end(error=type(e).__name__)
-                self._note_failure(executor, e)
-                return
-            self._watchdog.end(executor.label, seq, now=self.clock())
-            if requests and all(r.future.done() for r in requests):
-                # stale settle (watchdog fired, batch redistributed): the
-                # verdicts were already delivered by the re-dispatch;
-                # drop these — ServeFuture is single-assignment anyway
-                bspan.end(result="stale")
-                return
-            self._note_success(executor)
-            if self.mode == "per_credential":
-                demux(requests, result[: len(requests)], clock=self.clock)
-                bspan.end(result="demuxed")
-                return
-            if result:
-                demux(requests, [True] * len(requests), clock=self.clock)
-                bspan.end(result="accepted")
-                return
-            # grouped rejection: recover per-request verdicts by
-            # bisection so one forged credential fails only its own
-            # future; culprit dead-letter lines carry the CULPRIT
-            # request's trace_id (not the batch's), so an operator greps
-            # straight from a JSONL line to the request's span tree —
-            # which names the device via its batch span
-            culprits = (
-                set(
-                    self._bisector(
-                        sigs,
-                        messages_list,
-                        seq,
-                        attempts,
-                        trace_ids=[r.future.trace_id for r in requests],
-                    )
-                )
-                if self._bisector is not None
-                else set(range(len(requests)))
-            )
-            for i in culprits:
-                if i < len(requests):
-                    requests[i].span.event("dead_letter", batch_seq=seq)
-            demux(
-                requests,
-                [i not in culprits for i in range(len(requests))],
-                clock=self.clock,
-            )
-            bspan.end(result="bisected", n_culprits=len(culprits))
-
-    # -- placer --------------------------------------------------------------
-
-    def _crash(self, e):
-        """Placer crash, or the LAST executor died: sweep every queued and
-        inbox future with the crash exception — no caller ever hangs."""
-        self._crashed = e
-        self._queue.close()
-        fail_all(self._queue.drain_pending(), e)
-        for ex in self._all_executors():
-            ex.poison(e)
-
-    def _run(self):
-        try:
-            while True:
-                batch = self._batcher.next_batch(
-                    block=True, ready=self._has_capacity
-                )
-                if batch is None:
-                    # closed and fully routed: executors drain their
-                    # inboxes; drain()/shutdown() closes and joins them
-                    return
-                self._place(batch).submit_batch(batch)
-        except BaseException as e:
-            self._crash(e)
-            raise

@@ -559,6 +559,12 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
     through the native C++ hash-to-group when available (bit-identical to
     the spec; tests/vectors/hashing.json).
 
+    `elgamal_pk` is either ONE ElGamal public key shared by the whole
+    batch, or a list of B per-request keys (the engine's prepare lane
+    coalesces unrelated users into one batch, so each request encrypts
+    under its own key; per-request keys route the pk^k terms through the
+    distinct-base MSM instead of the shared comb).
+
     Returns [(request, randomness)] — randomness = [r, k_1..k_hidden] per
     request, exactly as the sequential path."""
     from .backend import get_backend
@@ -566,6 +572,16 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
     B = len(messages_list)
     if B == 0:
         return []
+    # per-request keys arrive as a Python LIST (affine points themselves
+    # are tuples, so tuple cannot mean per-request here)
+    pk_list = None
+    if isinstance(elgamal_pk, list):
+        pk_list = list(elgamal_pk)
+        if len(pk_list) != B:
+            raise GeneralError(
+                "elgamal_pk list length %d != batch size %d"
+                % (len(pk_list), B)
+            )
     if backend is None:
         backend = get_backend("python")
     elif isinstance(backend, str):
@@ -624,7 +640,17 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
     distinct_api = async_distinct_api(backend, grp)
     many = getattr(backend, "msm_%s_shared_many" % grp, None)
     elg_handle = None
-    if many_api is not None:
+    if pk_list is not None:
+        # per-request keys: pk is a distinct base per lane, so the
+        # shared-comb ElGamal program does not apply — take the
+        # synchronous path with pk^k through the distinct-base MSM
+        commitments = msm_shared(commit_bases, commit_rows)
+        gk = msm_shared([params.g], flat_k)
+        pkk = msm_distinct(
+            [[pk_list[i]] for i in range(B) for _ in range(count_hidden)],
+            flat_k,
+        )
+    elif many_api is not None:
         many_dispatch, many_wait = many_api
         commit_handle = many_dispatch([(commit_bases, commit_rows)])
         elg_handle = many_dispatch(

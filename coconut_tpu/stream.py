@@ -416,7 +416,8 @@ def _group_oracle(backend, vk, params):
 
 
 def _make_bisector(
-    backend, fallback_backend, vk, params, policy, dead_letter_path
+    backend, fallback_backend, vk, params, policy, dead_letter_path,
+    program=None,
 ):
     """bisect(sigs, msgs, batch_index, attempts) -> culprit indices.
 
@@ -481,6 +482,7 @@ def _make_bisector(
                             if trace_ids is not None and c < len(trace_ids)
                             else None
                         ),
+                        program=program,
                     )
                     metrics.count("dead_letters")
         return culprits

@@ -81,6 +81,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "engine: unified execution-engine suite (program registration, "
+        "cross-program placement, per-program jit-shape caches, typed "
+        "error hierarchy, online/offline show parity, full-session "
+        "pipeline), also run explicitly by ci.sh's engine lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

@@ -1,0 +1,294 @@
+"""Unified execution-engine suite (ISSUE 12).
+
+Covers the PR-12 contract surface:
+
+  - the typed retriable-error hierarchy: every loud-but-retriable
+    refusal (overload, brownout, quorum loss) is ONE isinstance branch
+    (ServiceRetryableError) and carries `program` + `retry_after_s`;
+  - online/offline parity: show-verify and show-prove through the
+    engine's batched lanes are bit-identical to the direct
+    ps.batch_show_verify / pok_sig.batch_show calls — including the
+    clone-first-proof pad convention and ragged final batches;
+  - the full-session pipeline: prepare -> mint -> verify -> show_prove
+    -> show_verify composes on ONE engine, and the per-program
+    jit-shape counters stay flat after warmup (the no-cross-program-
+    recompile proof).
+
+Real crypto on small parameters (3 messages, t=2-of-3) over the python
+backend — seconds, not minutes. ci.sh's engine lane runs this suite
+plus probes/probe_engine.py (the crash-injection acceptance smoke)."""
+
+from types import SimpleNamespace
+
+import pytest
+
+from coconut_tpu import metrics, pok_sig, ps
+from coconut_tpu.backend import get_backend
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.engine import ProtocolEngine
+from coconut_tpu.errors import (
+    CoconutError,
+    QuorumUnreachableError,
+    ServiceBrownoutError,
+    ServiceOverloadedError,
+    ServiceRetryableError,
+)
+from coconut_tpu.keygen import trusted_party_SSS_keygen
+from coconut_tpu.ops.fields import R
+from coconut_tpu.params import Params
+from coconut_tpu.signature import Verkey
+from coconut_tpu.sss import rand_fr
+
+pytestmark = pytest.mark.engine
+
+MSGS = 3
+HIDDEN = 1
+REVEALED = [1, 2]
+THRESHOLD, TOTAL = 2, 3
+NAMESPACES = ("serve", "prep", "prove", "showv")
+
+
+@pytest.fixture(scope="module")
+def world():
+    params = Params.new(MSGS, b"test-engine")
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params)
+    vk = Verkey.aggregate(
+        THRESHOLD, [(s.id, s.verkey) for s in signers], ctx=params.ctx
+    )
+    return SimpleNamespace(
+        params=params,
+        signers=signers,
+        vk=vk,
+        backend=get_backend("python"),
+    )
+
+
+def _engine(world, **kw):
+    kw.setdefault("devices", 1)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 10.0)
+    return ProtocolEngine(
+        world.signers,
+        world.params,
+        THRESHOLD,
+        count_hidden=HIDDEN,
+        revealed_msg_indices=REVEALED,
+        backend=world.backend,
+        **kw
+    ).start()
+
+
+@pytest.fixture(scope="module")
+def creds(world):
+    """Five minted (credential, messages) pairs — minted ONCE through a
+    real engine (prepare + mint lanes), shared by the parity tests."""
+    eng = _engine(world)
+    out = []
+    try:
+        for _ in range(5):
+            msgs = [rand_fr() for _ in range(MSGS)]
+            esk, epk = elgamal_keygen(world.params.ctx.sig, world.params.g)
+            req, _ = eng.submit_prepare(msgs, epk).result(timeout=120.0)
+            sig = eng.submit_mint(req, msgs, esk).result(timeout=120.0)
+            out.append((sig, msgs))
+    finally:
+        assert eng.drain(timeout=60.0)
+    return out
+
+
+# --- satellite: the typed retriable-error hierarchy ------------------------
+
+
+def test_retryable_error_hierarchy():
+    """One isinstance branch covers every loud-but-retriable refusal,
+    and each subclass carries the program name + retry-after hint."""
+    for cls in (
+        ServiceOverloadedError,
+        ServiceBrownoutError,
+        QuorumUnreachableError,
+    ):
+        assert issubclass(cls, ServiceRetryableError)
+        assert issubclass(cls, CoconutError)
+
+    over = ServiceOverloadedError(8, 8, program="verify", retry_after_s=0.25)
+    assert over.program == "verify"
+    assert over.retry_after_s == 0.25
+    assert (over.depth, over.max_depth) == (8, 8)
+
+    brown = ServiceBrownoutError(
+        "bulk", 0.5, depth=3, capacity_fraction=0.5, program="prepare"
+    )
+    assert brown.program == "prepare"
+    assert brown.retry_after_s == 0.5
+    assert brown.lane == "bulk"
+
+    quorum = QuorumUnreachableError(
+        3, 1, live=1, program="mint", retry_after_s=1.0
+    )
+    assert quorum.program == "mint"
+    assert quorum.retry_after_s == 1.0
+    assert (quorum.needed, quorum.have, quorum.live) == (3, 1, 1)
+
+    # clients branch on the ONE base type, reading the shared fields
+    for err in (over, brown, quorum):
+        assert isinstance(err, ServiceRetryableError)
+        assert err.program is not None
+        assert err.retry_after_s is not None
+
+    # legacy single-program call sites default both fields to None
+    legacy = ServiceOverloadedError(1, 1)
+    assert legacy.program is None and legacy.retry_after_s is None
+
+
+# --- online/offline parity -------------------------------------------------
+
+
+def test_show_verify_parity_ragged_and_padded(world, creds):
+    """Five proofs through a max_batch=4 engine lane — one full batch
+    plus a ragged final batch padded clone-first-proof — must produce
+    verdict bits identical to ONE direct ps.batch_show_verify call,
+    including a tampered (False) lane."""
+    sigs = [s for s, _ in creds]
+    msgs = [m for _, m in creds]
+    proofs, challenges, revealed_list = pok_sig.batch_show(
+        sigs, world.vk, world.params, msgs, REVEALED, backend=world.backend
+    )
+    # tamper one lane's revealed message: structurally valid, must fail
+    revealed_list = [dict(d) for d in revealed_list]
+    revealed_list[2][REVEALED[0]] = (revealed_list[2][REVEALED[0]] + 1) % R
+
+    direct = ps.batch_show_verify(
+        proofs,
+        world.vk,
+        world.params,
+        revealed_list,
+        challenges=challenges,
+        backend=world.backend,
+    )
+    assert list(direct) == [True, True, False, True, True]
+
+    metrics.reset()
+    eng = _engine(world, max_batch=4, max_wait_ms=10.0)
+    try:
+        futs = [
+            eng.submit_show_verify(p, rev, chal)
+            for p, rev, chal in zip(proofs, revealed_list, challenges)
+        ]
+        online = [f.result(timeout=120.0) for f in futs]
+    finally:
+        assert eng.drain(timeout=60.0)
+
+    assert online == list(direct)
+    # the ragged final batch (1 request) really was padded to max_batch
+    assert metrics.get_count("showv_pad_lanes") == 3
+    assert metrics.get_count("showv_valid") == 4
+    assert metrics.get_count("showv_invalid") == 1
+
+
+def test_show_verify_challenge_recompute_parity(world, creds):
+    """challenge=None (the stranger-verifier path) recomputes the
+    Fiat-Shamir challenge at assemble time and agrees with the direct
+    explicit-challenge verdict."""
+    sig, msgs = creds[0]
+    (proof,), (chal,), (rev,) = pok_sig.batch_show(
+        [sig], world.vk, world.params, [msgs], REVEALED,
+        backend=world.backend,
+    )
+    assert ps.batch_show_verify(
+        [proof], world.vk, world.params, [rev], challenges=[chal],
+        backend=world.backend,
+    ) == [True]
+
+    eng = _engine(world)
+    try:
+        assert eng.submit_show_verify(proof, rev).result(timeout=120.0)
+    finally:
+        assert eng.drain(timeout=60.0)
+
+
+def test_show_prove_parity_bit_identical(world, creds, monkeypatch):
+    """With the randomness stream pinned, one engine show_prove batch is
+    bit-identical to the direct pok_sig.batch_show call: same proofs
+    (transcript bytes), same challenges, same revealed maps. Draw-order
+    sensitivity is the point — pad_partial=False and max_batch=2 make
+    the engine dispatch EXACTLY the direct call."""
+    draws = [rand_fr() for _ in range(64)]
+
+    def replayer():
+        it = iter(draws)
+        return lambda: next(it)
+
+    sigs = [creds[0][0], creds[1][0]]
+    msgs = [creds[0][1], creds[1][1]]
+
+    monkeypatch.setattr(pok_sig, "rand_fr", replayer())
+    d_proofs, d_chals, d_revealed = pok_sig.batch_show(
+        sigs, world.vk, world.params, msgs, REVEALED, backend=world.backend
+    )
+
+    monkeypatch.setattr(pok_sig, "rand_fr", replayer())
+    eng = _engine(world, max_batch=2, max_wait_ms=500.0, pad_partial=False)
+    try:
+        futs = [
+            eng.submit_show_prove(s, m) for s, m in zip(sigs, msgs)
+        ]
+        online = [f.result(timeout=120.0) for f in futs]
+    finally:
+        assert eng.drain(timeout=60.0)
+
+    for i, (proof, chal, rev) in enumerate(online):
+        assert chal == d_chals[i]
+        assert rev == d_revealed[i]
+        assert proof.to_bytes_for_challenge(
+            world.vk, world.params
+        ) == d_proofs[i].to_bytes_for_challenge(world.vk, world.params)
+    # and the online proofs verify
+    assert ps.batch_show_verify(
+        [p for p, _, _ in online],
+        world.vk,
+        world.params,
+        [r for _, _, r in online],
+        challenges=[c for _, c, _ in online],
+        backend=world.backend,
+    ) == [True, True]
+
+
+# --- the full-session pipeline + jit-shape stability -----------------------
+
+
+def test_full_session_pipeline_and_jit_stability(world):
+    """All five phases compose on ONE engine, and after a one-session
+    warmup the per-program jit-shape counters never move again — mixed
+    heterogeneous traffic causes zero cross-program recompiles."""
+    metrics.reset()
+    eng = _engine(world, devices=2, max_batch=4, max_wait_ms=5.0)
+
+    def session():
+        msgs = [rand_fr() for _ in range(MSGS)]
+        esk, epk = elgamal_keygen(world.params.ctx.sig, world.params.g)
+        req, _ = eng.submit_prepare(msgs, epk).result(timeout=120.0)
+        cred = eng.submit_mint(req, msgs, esk).result(timeout=120.0)
+        assert eng.submit_verify(cred, msgs).result(timeout=120.0)
+        proof, chal, rev = eng.submit_show_prove(cred, msgs).result(
+            timeout=120.0
+        )
+        assert eng.submit_show_verify(proof, rev, chal).result(
+            timeout=120.0
+        )
+
+    try:
+        session()  # warmup: compiles every pool program's serving shape
+        warm = {
+            ns: metrics.get_count("%s_jit_shapes" % ns) for ns in NAMESPACES
+        }
+        assert all(v >= 1 for v in warm.values()), warm
+        for _ in range(2):
+            session()
+        end = {
+            ns: metrics.get_count("%s_jit_shapes" % ns) for ns in NAMESPACES
+        }
+    finally:
+        assert eng.drain(timeout=60.0)
+
+    assert end == warm, "cross-program recompile: %r -> %r" % (warm, end)
+    assert metrics.get_count("issue_minted") == 3

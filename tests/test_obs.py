@@ -463,7 +463,8 @@ def test_serve_request_span_tree_retry_and_bisection(_traced, clock, tmp_path):
     assert spans["device"].attrs["device"] == "0"
     # dead-letter line joins back on the victim's trace_id
     (rec,) = DeadLetterLog.read(dlq)
-    assert rec["trace_id"] == victim.trace_id and rec["schema"] == 2
+    assert rec["trace_id"] == victim.trace_id and rec["schema"] == 3
+    assert rec["program"] == "verify"
     # flight record rides next to the dead-letter log with the full tree
     (flight,) = oflight.read(dlq)
     assert flight["trace_id"] == victim.trace_id
