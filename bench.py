@@ -22,6 +22,7 @@ Also measured (BASELINE.md configs):
   serve lane: loadgen against the online CredentialService         [--serve]
   issue lane: loadgen against the online IssuanceService           [--issue]
   session lane: full-session loadgen against the ProtocolEngine    [--session]
+  gateway lane: RPC-vs-direct goodput through the fleet gateway    [--gateway]
 
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
@@ -68,6 +69,17 @@ Knobs: BENCH_SESSION_SECONDS (default 2), BENCH_SESSION_MAX_BATCH
 BENCH_SESSION_AUTHORITIES/BENCH_SESSION_THRESHOLD (default 3, t=2);
 BENCH_SESSION=0 skips; composes with the other lanes and
 BENCH_OFFLINE=0.
+
+Gateway lane (`python bench.py --gateway`, ISSUE 13): the SAME warm
+CredentialService measured twice back-to-back under the closed-loop
+verify loadgen — direct submit calls, then through a net.Replica over a
+real loopback TCP socket (CTS-RPC/1 frames both ways via
+GatewayClient) — embedding both reports, the goodput ratio, and the
+measured per-request rpc_overhead_s under "gateway". Asserts RPC
+goodput >= BENCH_GATEWAY_MIN_RATIO (default 0.8) of direct. Knobs:
+BENCH_GATEWAY_SECONDS (default 2), BENCH_GATEWAY_MAX_BATCH (default 4),
+BENCH_GATEWAY_CONCURRENCY (default 2*max_batch); BENCH_GATEWAY=0 skips;
+composes with the other lanes and BENCH_OFFLINE=0.
 
 Chaos-recovery sub-report (ISSUE 9, on by default with --serve;
 BENCH_CHAOS=0 skips): a three-phase loadgen pass — clean, then one
@@ -406,6 +418,86 @@ def bench_session(ge, params, extras, backend_name):
     return report["sessions_per_s"]
 
 
+def bench_gateway(ge, params, vk, sigs, msgs_list, extras, backend_name):
+    """RPC-ingress lane (--gateway, ISSUE 13): measure the wire tax. The
+    SAME warm CredentialService is driven twice back-to-back by the
+    closed-loop verify loadgen — direct submit calls, then through a
+    net.Replica serving CTS-RPC/1 frames on a real loopback TCP socket
+    (SocketTransport + GatewayClient). Embeds both reports, the goodput
+    ratio, and the measured per-request rpc_overhead_s under
+    extras["gateway"]; asserts ratio >= BENCH_GATEWAY_MIN_RATIO
+    (default 0.8). Returns the RPC goodput (requests/sec).
+    BENCH_GATEWAY=0 skips."""
+    from coconut_tpu import net
+    from coconut_tpu.serve import CredentialService, run_loadgen
+
+    seconds = float(os.environ.get("BENCH_GATEWAY_SECONDS", "2"))
+    max_batch = int(os.environ.get("BENCH_GATEWAY_MAX_BATCH", "4"))
+    concurrency = int(
+        os.environ.get("BENCH_GATEWAY_CONCURRENCY", str(2 * max_batch))
+    )
+    min_ratio = float(os.environ.get("BENCH_GATEWAY_MIN_RATIO", "0.8"))
+
+    pool = [(s, m, True) for s, m in zip(sigs, msgs_list)][: 8 * max_batch]
+    codec = net.WireCodec(params)
+    svc = CredentialService(
+        backend_name, vk, params, max_batch=max_batch, max_wait_ms=20.0
+    )
+    replica = net.Replica(svc, codec, replica_id="bench-r0")
+    with svc:
+        # warm the backend at the serving shape outside both timed passes
+        warm = [
+            svc.submit(*pool[i % len(pool)][:2]) for i in range(max_batch)
+        ]
+        for f in warm:
+            f.result(timeout=600.0)
+        direct = run_loadgen(
+            svc, pool, duration_s=seconds, arrival="closed",
+            concurrency=concurrency,
+        )
+        replica.serve()
+        client = net.GatewayClient(net.SocketTransport(replica.address),
+                                   codec)
+        try:
+            rpc = run_loadgen(
+                client, pool, duration_s=seconds, arrival="closed",
+                concurrency=concurrency, transport="rpc",
+            )
+        finally:
+            client.close()
+            replica.close()
+    for name, rep in (("direct", direct), ("rpc", rpc)):
+        assert rep["completed"] > 0, (
+            "gateway lane %s pass completed nothing: %r" % (name, rep)
+        )
+        assert rep["dropped_futures"] == 0, (
+            "gateway lane %s pass dropped futures: %r" % (name, rep)
+        )
+        assert rep["verdict_mismatches"] == 0, (
+            "gateway lane %s pass verdict mismatch: %r" % (name, rep)
+        )
+    ratio = (
+        round(rpc["goodput_per_s"] / direct["goodput_per_s"], 4)
+        if direct["goodput_per_s"]
+        else None
+    )
+    assert ratio is not None and ratio >= min_ratio, (
+        "RPC ingress costs too much: rpc/direct goodput ratio %r < %r "
+        "(direct=%r rpc=%r)"
+        % (ratio, min_ratio, direct["goodput_per_s"],
+           rpc["goodput_per_s"])
+    )
+    extras["gateway"] = {
+        "max_batch": max_batch,
+        "concurrency": concurrency,
+        "min_ratio": min_ratio,
+        "goodput_ratio": ratio,
+        "direct": direct,
+        "rpc": rpc,
+    }
+    return rpc["goodput_per_s"]
+
+
 def _bench_chaos_recovery(params, vk, pool, backend_name, mode, max_batch,
                           max_wait_ms):
     """Self-healing recovery datapoint (ISSUE 9): goodput before / during /
@@ -609,10 +701,14 @@ def main():
         "--session" in sys.argv[1:]
         and os.environ.get("BENCH_SESSION", "1") == "1"
     )
+    gateway_flag = (
+        "--gateway" in sys.argv[1:]
+        and os.environ.get("BENCH_GATEWAY", "1") == "1"
+    )
     # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
     # offline lanes so the CI online smokes don't pay for them
     offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
-        serve_flag or issue_flag or session_flag
+        serve_flag or issue_flag or session_flag or gateway_flag
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -665,6 +761,14 @@ def main():
         if value is None:
             value = sessions_per_s
             metric, unit = "session_sessions_per_sec", "sessions/sec"
+
+    if gateway_flag:
+        rpc_goodput = bench_gateway(
+            ge, params, vk, sigs, msgs_list, extras, backend_name
+        )
+        if value is None:
+            value = rpc_goodput
+            metric, unit = "gateway_rpc_goodput_per_sec", "requests/sec"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

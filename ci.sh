@@ -219,6 +219,43 @@ print("session smoke: ok (%.1f sessions/s, p95 %.0f ms, jit shapes "
           len(report["per_program"])))
 EOF
 
+echo "== gateway lane (wire-format RPC ingress / tenant admission / replica router) =="
+# the marker suite: byte-exact wire golden vectors, strict-decode
+# rejection, typed error envelopes round-tripped, fake-clock token
+# buckets and gossip, consistent-hash affinity, loopback-fleet chaos
+python -m pytest tests/ -m gateway -q
+# end-to-end acceptance smoke (ISSUE 13): a REAL 3-replica fleet over
+# loopback TCP sockets behind the router + gossip thread. The probe
+# kills one replica mid-run and asserts: every in-flight future settles
+# via retry on the survivors (zero dangling), the router demotes the
+# dead replica, the over-quota tenant alone is refused, and the replica
+# REJOINS via a fresh beacon after its serve loop restarts.
+JAX_PLATFORMS=cpu python probes/probe_gateway.py
+# RPC-tax bench smoke: the same warm CredentialService direct vs through
+# the wire (real socket), asserted from the JSON artifact a human reads —
+# the ISSUE 13 floor is RPC goodput >= 80% of direct
+GATEWAY_JSON=$(mktemp -d)/gateway.json
+BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 BENCH_CHAOS=0 \
+  BENCH_GATEWAY_SECONDS=2 BENCH_GATEWAY_MAX_BATCH=4 JAX_PLATFORMS=cpu \
+  python bench.py --gateway > "$GATEWAY_JSON"
+GATEWAY_JSON_PATH="$GATEWAY_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["GATEWAY_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["gateway"]
+assert report["goodput_ratio"] >= report["min_ratio"], report
+for side in ("direct", "rpc"):
+    assert report[side]["completed"] > 0, report
+    assert report[side]["errors"] == 0, report
+    assert report[side]["dropped_futures"] == 0, report
+    assert report[side]["verdict_mismatches"] == 0, report
+assert report["rpc"]["rpc_overhead_s"] is not None, report
+print("gateway smoke: ok (rpc/direct goodput ratio %.2f, "
+      "rpc overhead %.1f ms/req)" % (
+          report["goodput_ratio"],
+          report["rpc"]["rpc_overhead_s"] * 1000.0))
+EOF
+
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q
 # end-to-end acceptance smoke on the REAL service (CPU, stub backend):

@@ -1,11 +1,42 @@
 """Typed errors mirroring the reference's `CoconutErrorKind` (errors.rs:5-24),
 with the SURVEY.md §5 mandate applied: no asserts in library code — hot-path
 `assert!`/`unwrap` in the reference (signature.rs:133-134,289-290,449,477)
-become raised, typed exceptions here."""
+become raised, typed exceptions here.
+
+WIRE CONTRACT (PR 13, coconut_tpu/net): every error class carries a stable
+machine-readable `code` (a class attribute, overridable per instance by the
+wire decoder) that maps 1:1 onto the gateway's error envelopes, and every
+`ServiceRetryableError` carries a `retry_after_s` that is ALWAYS a finite
+float >= 0 — constructors normalize None/negative/non-finite hints to 0.0
+so neither local callers nor the wire codec ever defend against None."""
+
+import math
+
+
+def _finite_retry_after(value):
+    """Clamp a retry-after hint to a finite float >= 0 (0.0 = "no
+    estimate, retry at will") — the wire-format invariant every
+    ServiceRetryableError upholds."""
+    if value is None:
+        return 0.0
+    try:
+        value = float(value)
+    except (TypeError, ValueError):
+        return 0.0
+    if not math.isfinite(value) or value < 0.0:
+        return 0.0
+    return value
 
 
 class CoconutError(Exception):
-    """Base class for all framework errors (reference: errors.rs:26-56)."""
+    """Base class for all framework errors (reference: errors.rs:26-56).
+
+    `code` is the stable machine-readable identifier the fleet gateway
+    (coconut_tpu/net/wire.py) puts in error envelopes; subclasses override
+    it, and the wire decoder may stamp a more specific instance-level code
+    when reconstructing a remote error."""
+
+    code = "error"
 
 
 class UnsupportedNoOfMessages(CoconutError):
@@ -39,9 +70,13 @@ class DeserializationError(CoconutError):
     """Malformed or non-canonical byte encoding (rebuild addition: the
     reference had no wire validation — SURVEY.md §4 'gaps to improve')."""
 
+    code = "bad_request"
+
 
 class GeneralError(CoconutError):
     """Catch-all with a message (errors.rs:22-23)."""
+
+    code = "general"
 
 
 class TransientBackendError(CoconutError):
@@ -52,6 +87,8 @@ class TransientBackendError(CoconutError):
     back to a designated backend; any other exception class is treated as
     permanent and propagates immediately."""
 
+    code = "transient"
+
 
 class ServiceRetryableError(CoconutError):
     """Base for every LOUD-but-retriable refusal an online service emits
@@ -59,14 +96,32 @@ class ServiceRetryableError(CoconutError):
     contract (coconut_tpu/engine): every subclass carries `program` — the
     engine program (verify / mint / prepare / show_prove / show_verify)
     that refused, or None for single-program legacy call sites — and
-    `retry_after_s`, the service's hint for when capacity should be back
-    (None when it has no estimate). Clients branch on this ONE type to
-    implement backoff-and-resubmit without enumerating refusal kinds."""
+    `retry_after_s`, the service's hint for when capacity should be back:
+    ALWAYS a finite float >= 0 (0.0 = no estimate; None / negative /
+    non-finite hints are normalized at construction). Clients branch on
+    this ONE type to implement backoff-and-resubmit without enumerating
+    refusal kinds; `code` names the refusal kind machine-readably and is
+    what the gateway's wire error envelopes carry."""
+
+    code = "retryable"
 
     def __init__(self, message, program=None, retry_after_s=None):
         super().__init__(message)
         self.program = program
-        self.retry_after_s = retry_after_s
+        self.retry_after_s = _finite_retry_after(retry_after_s)
+
+    @classmethod
+    def from_wire(cls, message, program=None, retry_after_s=0.0):
+        """Reconstruct a retriable refusal from a decoded wire envelope.
+        Bypasses the subclass constructor (an envelope carries only the
+        shared fields — message/code/program/retry_after_s — not the
+        structural detail like queue depths), so a wire-reconstructed
+        error has the base contract but may lack subclass extras."""
+        err = cls.__new__(cls)
+        ServiceRetryableError.__init__(
+            err, message, program=program, retry_after_s=retry_after_s
+        )
+        return err
 
 
 class ServiceOverloadedError(ServiceRetryableError):
@@ -76,6 +131,8 @@ class ServiceOverloadedError(ServiceRetryableError):
     "serve_rejected" counter tracks how often this fires. Carries `depth`
     (current) and `max_depth` (the configured admission bound), plus the
     ServiceRetryableError `program` / `retry_after_s` fields."""
+
+    code = "overloaded"
 
     def __init__(self, depth, max_depth, program=None, retry_after_s=None):
         super().__init__(
@@ -97,6 +154,8 @@ class ServiceBrownoutError(ServiceRetryableError):
     `retry_after_s`, the service's pressure-scaled hint for when capacity
     should be back (probation probes re-admitting devices, or the queue
     draining). Counted under "serve_shed_bulk"."""
+
+    code = "brownout"
 
     def __init__(
         self,
@@ -133,6 +192,8 @@ class QuorumUnreachableError(ServiceRetryableError):
     contribute when the service gave up). Counted under
     "issue_quorum_unreachable"."""
 
+    code = "quorum_unreachable"
+
     def __init__(self, needed, have, live=0, program=None, retry_after_s=None):
         super().__init__(
             "issuance quorum unreachable: have %d of %d required partial "
@@ -151,6 +212,94 @@ class ServiceClosedError(CoconutError):
     service that is draining or shut down (serve/service.py). Futures of
     requests abandoned by a non-draining shutdown resolve with this
     exception so no caller ever hangs on a dropped future."""
+
+    code = "closed"
+
+
+class TenantAuthError(CoconutError):
+    """The gateway (coconut_tpu/net) rejected a request whose API key maps
+    to no provisioned tenant. NOT retriable: resubmitting the same key
+    can never succeed. Counted under "gateway_auth_failures"."""
+
+    code = "tenant_auth"
+
+
+class TenantQuotaError(CoconutError):
+    """A tenant's absolute request quota is exhausted (net/tenant.py).
+    NOT retriable within the quota epoch — unlike a token-bucket throttle
+    there is no refill to wait for; the operator must raise the quota.
+    Counted under "gateway_tenant_<id>_quota_rejected"."""
+
+    code = "tenant_quota"
+
+    def __init__(self, tenant, used, quota):
+        super().__init__(
+            "tenant %r quota exhausted (%d/%d requests): raise the quota "
+            "or rotate the epoch" % (tenant, used, quota)
+        )
+        self.tenant = tenant
+        self.used = used
+        self.quota = quota
+
+
+class TenantRateLimitError(ServiceRetryableError):
+    """A tenant's token bucket is empty (net/tenant.py): the request was
+    refused BEFORE engine admission. RETRIABLE — `retry_after_s` is the
+    bucket's refill horizon for one token. Counted under
+    "gateway_tenant_<id>_throttled"."""
+
+    code = "tenant_rate_limited"
+
+    def __init__(self, tenant, retry_after_s, program=None):
+        super().__init__(
+            "tenant %r rate-limited: token bucket empty — retry after "
+            "~%.3gs" % (tenant, _finite_retry_after(retry_after_s)),
+            program=program,
+            retry_after_s=retry_after_s,
+        )
+        self.tenant = tenant
+
+
+#: the 1:1 code <-> class map the wire error envelope encodes/decodes
+#: through (net/wire.py). Retriable codes reconstruct via `from_wire`
+#: (shared fields only); the rest rebuild with their message.
+WIRE_ERROR_CODES = {
+    cls.code: cls
+    for cls in (
+        GeneralError,
+        DeserializationError,
+        TransientBackendError,
+        ServiceRetryableError,
+        ServiceOverloadedError,
+        ServiceBrownoutError,
+        QuorumUnreachableError,
+        ServiceClosedError,
+        TenantAuthError,
+        TenantQuotaError,
+        TenantRateLimitError,
+    )
+}
+
+
+def error_from_wire(code, message, program=None, retry_after_s=0.0):
+    """Rebuild the typed exception a wire error envelope describes.
+    Unknown codes degrade to GeneralError (forward compatibility: a newer
+    server may emit codes this client predates) with the code preserved
+    as an instance attribute so nothing is lost."""
+    cls = WIRE_ERROR_CODES.get(code)
+    if cls is None:
+        err = GeneralError(message)
+        err.code = code
+        return err
+    if issubclass(cls, ServiceRetryableError):
+        return cls.from_wire(
+            message, program=program, retry_after_s=retry_after_s
+        )
+    err = cls.__new__(cls)
+    CoconutError.__init__(err, message)
+    if program is not None:
+        err.program = program
+    return err
 
 
 class CheckpointCorruptError(CoconutError):

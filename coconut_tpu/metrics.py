@@ -247,6 +247,18 @@ def _hist_readout(h):
     }
 
 
+def hist_totals(name):
+    """(count, total_seconds) of histogram `name` over the FULL run —
+    exact, not window-bounded. (0, 0.0) if nothing was observed. The
+    RPC loadgen reads deltas of these to split client-observed latency
+    into engine time vs wire overhead (rpc_overhead_s)."""
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            return 0, 0.0
+        return h["count"], h["total"]
+
+
 def register_provider(name, fn):
     """Register a zero-arg callable whose result snapshot() embeds under
     `name` — how obs.trace contributes the per-stage span breakdown

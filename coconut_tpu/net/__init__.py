@@ -1,0 +1,63 @@
+"""Fleet gateway: wire-format RPC ingress, per-tenant admission, and a
+health-gossiping replica router (PR 13).
+
+The unified execution engine (coconut_tpu/engine/) serves one process;
+this package turns N such processes into one fleet behind a front door:
+
+  wire.py    CTS-RPC/1 — versioned length-prefixed frames, canonical
+             payload encodings for all five program request/response
+             pairs, the typed error envelope, and the health beacon
+  rpc.py     Replica (an engine behind a serve loop), Socket/Loopback
+             transports, and the typed GatewayClient mirroring
+             ProtocolEngine's submit_* surface over the wire
+  tenant.py  per-tenant API-key auth, token-bucket rate limits, and
+             quota counters — enforced BEFORE engine admission
+  gossip.py  HealthDirectory (UP/DEGRADED/DOWN per replica, fed by
+             periodic beacons) + the GossipLoop poller
+  router.py  ReplicaRouter — consistent-hash session affinity,
+             least-loaded spill, beacon-driven demotion, and bounded
+             failover retry on transport failure
+
+See README.md "Fleet deployment" for the wire format table, tenant
+knobs, routing policy, and the gateway_*/tenant_* metric glossary.
+"""
+
+from .gossip import DEGRADED, DOWN, UP, GossipLoop, HealthDirectory
+from .rpc import (
+    GatewayClient,
+    LoopbackTransport,
+    Replica,
+    SocketTransport,
+)
+from .router import ReplicaRouter
+from .tenant import Tenant, TenantTable, TokenBucket
+from .wire import (
+    MAX_FRAME_BYTES,
+    WIRE_VERSION,
+    Beacon,
+    WireCodec,
+    decode_frame,
+    encode_frame,
+)
+
+__all__ = [
+    "WIRE_VERSION",
+    "MAX_FRAME_BYTES",
+    "WireCodec",
+    "Beacon",
+    "encode_frame",
+    "decode_frame",
+    "Replica",
+    "GatewayClient",
+    "SocketTransport",
+    "LoopbackTransport",
+    "Tenant",
+    "TenantTable",
+    "TokenBucket",
+    "HealthDirectory",
+    "GossipLoop",
+    "UP",
+    "DEGRADED",
+    "DOWN",
+    "ReplicaRouter",
+]

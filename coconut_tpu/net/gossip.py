@@ -1,0 +1,201 @@
+"""Health gossip: the router's per-replica health directory, fed by
+periodic beacons (PR 13).
+
+Each replica self-reports a wire.Beacon (engine health-ladder summary,
+queue depth, brownout flag) when polled on its beacon endpoint. The
+router's GossipLoop polls every replica each interval; the
+HealthDirectory folds the results into a routing view with the SAME
+demotion shape PR 9 gives executors:
+
+  UP        beacons arriving, replica reports admissible capacity
+  DEGRADED  beacons arriving, but the replica reports itself
+            quarantine-level (zero admissible executors) or browned out
+            -> demoted for NEW sessions, eligible only as a last-resort
+            spill target
+  DOWN      `miss_threshold` consecutive poll failures (or an explicit
+            transport failure reported by the router's data path)
+            -> not routed to at all; an in-flight failure there is
+            retried on survivors
+
+A DOWN replica rejoins the moment a fresh admissible beacon lands —
+restart-and-readmit needs no operator action, exactly like the
+probation ladder re-admits executors.
+
+Counters: "gateway_beacons", "gateway_beacon_misses",
+"gateway_demoted", "gateway_readmitted"; gauge "gateway_up_replicas".
+Clock and polling are injectable: fake-clock tests call `step()`
+directly and never sleep.
+"""
+
+import threading
+import time
+
+from .. import metrics
+
+UP = "up"
+DEGRADED = "degraded"
+DOWN = "down"
+
+
+class _ReplicaView:
+    __slots__ = ("state", "beacon", "misses", "t_beacon")
+
+    def __init__(self):
+        self.state = UP  # optimistic until beacons say otherwise
+        self.beacon = None
+        self.misses = 0
+        self.t_beacon = None
+
+
+class HealthDirectory:
+    """The router's view of every replica's health. Thread-safe: the
+    gossip loop writes while router data-path threads read and report
+    transport failures."""
+
+    def __init__(self, replica_ids=(), miss_threshold=3):
+        if miss_threshold < 1:
+            raise ValueError(
+                "miss_threshold must be >= 1 (got %r)" % (miss_threshold,)
+            )
+        self.miss_threshold = miss_threshold
+        self._lock = threading.Lock()
+        self._views = {}
+        for rid in replica_ids:
+            self._views[rid] = _ReplicaView()
+        self._publish_locked()
+
+    def _view(self, rid):
+        v = self._views.get(rid)
+        if v is None:
+            v = self._views[rid] = _ReplicaView()
+        return v
+
+    def _publish_locked(self):
+        metrics.set_gauge(
+            "gateway_up_replicas",
+            sum(1 for v in self._views.values() if v.state == UP),
+        )
+
+    def observe(self, beacon, now=None):
+        """Fold one received beacon in; a DOWN/DEGRADED replica whose
+        fresh beacon reports admissible capacity is readmitted."""
+        with self._lock:
+            v = self._view(beacon.replica_id)
+            was = v.state
+            v.beacon = beacon
+            v.misses = 0
+            v.t_beacon = now
+            degraded = (not beacon.admissible()) or beacon.brownout
+            v.state = DEGRADED if degraded else UP
+            if was != UP and v.state == UP:
+                metrics.count("gateway_readmitted")
+            if was == UP and v.state != UP:
+                metrics.count("gateway_demoted")
+            metrics.count("gateway_beacons")
+            self._publish_locked()
+
+    def miss(self, rid):
+        """One failed beacon poll; `miss_threshold` consecutive misses
+        demote the replica to DOWN."""
+        with self._lock:
+            v = self._view(rid)
+            v.misses += 1
+            metrics.count("gateway_beacon_misses")
+            if v.misses >= self.miss_threshold and v.state != DOWN:
+                v.state = DOWN
+                metrics.count("gateway_demoted")
+            self._publish_locked()
+
+    def note_failure(self, rid):
+        """The router's DATA PATH hit a transport failure on `rid`:
+        demote immediately — waiting out miss_threshold beacon intervals
+        would keep routing sessions into a dead socket."""
+        with self._lock:
+            v = self._view(rid)
+            v.misses = max(v.misses, self.miss_threshold)
+            if v.state != DOWN:
+                v.state = DOWN
+                metrics.count("gateway_demoted")
+            self._publish_locked()
+
+    def state(self, rid):
+        with self._lock:
+            return self._view(rid).state
+
+    def beacon(self, rid):
+        with self._lock:
+            return self._views[rid].beacon if rid in self._views else None
+
+    def queue_depth(self, rid):
+        """Last-beacon queue depth (the least-loaded spill key); unknown
+        replicas sort last."""
+        with self._lock:
+            v = self._views.get(rid)
+            if v is None or v.beacon is None:
+                return float("inf")
+            return v.beacon.queue_depth
+
+    def states(self):
+        with self._lock:
+            return {rid: v.state for rid, v in self._views.items()}
+
+    def routable(self, rid):
+        return self.state(rid) == UP
+
+    def usable(self, rid):
+        """UP or DEGRADED — the spill pool (DEGRADED beats DOWN: a
+        browned-out replica still answers, a dead one does not)."""
+        return self.state(rid) != DOWN
+
+
+class GossipLoop:
+    """Poll every replica's beacon endpoint each interval and feed the
+    directory. `pollers` maps replica_id -> zero-arg callable returning a
+    wire.Beacon (raising on transport failure = a miss). Fake-clock tests
+    call step() directly; start() runs the real thread."""
+
+    def __init__(
+        self,
+        directory,
+        pollers,
+        interval_s=0.25,
+        clock=time.monotonic,
+    ):
+        self.directory = directory
+        self.pollers = dict(pollers)
+        self.interval_s = interval_s
+        self.clock = clock
+        self._stop = threading.Event()
+        self._thread = None
+
+    def step(self, now=None):
+        """One poll sweep across every replica."""
+        now = self.clock() if now is None else now
+        for rid, poll in self.pollers.items():
+            try:
+                beacon = poll()
+            except Exception:
+                self.directory.miss(rid)
+                continue
+            self.directory.observe(beacon, now=now)
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="gateway-gossip", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            self.step()
+
+    def stop(self, timeout=None):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            alive = self._thread.is_alive()
+            self._thread = None
+            return not alive
+        return True

@@ -47,6 +47,18 @@ accumulated over the run. Both workloads share the arrival discipline:
 under a closed loop they compete for the same client threads, which is
 exactly the interference a mixed fleet sees.
 
+RPC TRANSPORT (`transport="rpc"`): point either loadgen at a fleet
+gateway client instead of the engine — a net.GatewayClient (one
+replica) or net.ReplicaRouter (the fleet front door) already mirrors
+the engine's submit_* surface, and the decoded error envelopes re-raise
+as the SAME typed exceptions, so the driver logic is shared verbatim.
+The report then adds `rpc_overhead_s`: client-observed mean latency
+minus the engine-side mean (the delta of the engine's own *_latency_s
+histograms over the run) — the wire + framing + routing tax per
+request. The full-session driver additionally pins each session to a
+stable session id via the router's `bound(session)` seam, which is what
+exercises consistent-hash affinity end to end.
+
 Determinism knobs: `rng` (arrival jitter + pool sampling), `clock`, and
 `sleep` are injectable, so tests can drive the generator without
 wall-clock flakiness; the 2-second CI smoke uses the real ones.
@@ -134,6 +146,42 @@ def _placement_report(before_counts):
     return out if (out.get("single") or out.get("sharded")) else None
 
 
+#: engine-side latency histogram per program (metric namespaces from
+#: serve/batcher.py, engine/phases.py, issue/service.py) — the
+#: server-side term of the rpc_overhead_s subtraction
+_ENGINE_LATENCY_HISTS = (
+    "serve_latency_s",   # verify
+    "prep_latency_s",    # prepare
+    "issue_latency_s",   # mint
+    "prove_latency_s",   # show_prove
+    "showv_latency_s",   # show_verify
+)
+
+
+def _engine_latency_totals():
+    """Summed (count, total_s) over every engine-side latency hist."""
+    count, total = 0, 0.0
+    for name in _ENGINE_LATENCY_HISTS:
+        c, t = metrics.hist_totals(name)
+        count += c
+        total += t
+    return count, total
+
+
+def _rpc_overhead(transport, client_latencies, eng0, eng1):
+    """Client-observed mean latency minus the engine-side mean over the
+    run — the per-request wire/framing/routing tax. None for the direct
+    transport or when either side completed nothing."""
+    if transport != "rpc" or not client_latencies:
+        return None
+    d_count = eng1[0] - eng0[0]
+    d_total = eng1[1] - eng0[1]
+    if d_count <= 0:
+        return None
+    client_mean = sum(client_latencies) / len(client_latencies)
+    return round(max(client_mean - d_total / d_count, 0.0), 6)
+
+
 def _percentiles(latencies):
     return {
         "p50": metrics.percentile(latencies, 50),
@@ -199,6 +247,7 @@ def run_loadgen(
     issue_service=None,
     issue_pool=None,
     issue_fraction=0.0,
+    transport="direct",
 ):
     """Drive `service` for `duration_s` and return the report dict.
 
@@ -212,11 +261,17 @@ def run_loadgen(
     `issue_pool` (a list of (sig_request, messages, elgamal_sk) tuples),
     each arrival routes to issuance with probability `issue_fraction`;
     the report gains an "issue" section. issue_fraction=1.0 drives a
-    pure-issuance run (the bench --issue lane)."""
+    pure-issuance run (the bench --issue lane).
+
+    transport: "direct" (service IS the engine) or "rpc" (service is a
+    net.GatewayClient / net.ReplicaRouter; the report adds
+    `rpc_overhead_s` when the replica engines share this process)."""
     if not pool:
         raise ValueError("loadgen pool must be non-empty")
     if arrival not in ("closed", "open"):
         raise ValueError("unknown arrival discipline %r" % (arrival,))
+    if transport not in ("direct", "rpc"):
+        raise ValueError("unknown transport %r" % (transport,))
     if not 0.0 <= issue_fraction <= 1.0:
         raise ValueError(
             "issue_fraction must be in [0, 1] (got %r)" % (issue_fraction,)
@@ -235,6 +290,7 @@ def run_loadgen(
     placed0 = metrics.counters_with_prefix("serve_placed")
     issue0 = metrics.counters_with_prefix("issue")
     stages0 = _stage_totals()
+    eng_lat0 = _engine_latency_totals()
     t0 = clock()
     t_end = t0 + duration_s
 
@@ -319,8 +375,12 @@ def run_loadgen(
     elapsed = max(clock() - t0, 1e-9)
     d_reqs = metrics.get_count("serve_batched_requests") - occ0_reqs
     d_batches = metrics.get_count("serve_batches") - occ0_batches
+    # a gateway client has no max_batch (batching is server-side)
+    max_batch = getattr(service, "max_batch", None)
     occupancy = (
-        d_reqs / (d_batches * service.max_batch) if d_batches else None
+        d_reqs / (d_batches * max_batch)
+        if (d_batches and max_batch)
+        else None
     )
     issue_report = None
     if issue_service is not None and issue_fraction > 0.0:
@@ -329,6 +389,7 @@ def run_loadgen(
         )
     return {
         "arrival": arrival,
+        "transport": transport,
         "duration_s": round(elapsed, 3),
         "concurrency": concurrency if arrival == "closed" else None,
         "offered_rate_per_s": rate_per_s if arrival == "open" else None,
@@ -342,6 +403,9 @@ def run_loadgen(
         "invalid": tally.invalid,
         "verdict_mismatches": tally.mismatches,
         "latency_s": _percentiles(tally.latencies),
+        "rpc_overhead_s": _rpc_overhead(
+            transport, tally.latencies, eng_lat0, _engine_latency_totals()
+        ),
         "stage_breakdown_s": _stage_delta(stages0, _stage_totals()),
         "devices": _device_report(dev0_counts, dev0_timers, elapsed),
         "placement": _placement_report(placed0),
@@ -373,6 +437,7 @@ def run_session_loadgen(
     rng=None,
     clock=time.monotonic,
     result_timeout=60.0,
+    transport="direct",
 ):
     """Drive FULL protocol sessions against a ProtocolEngine: each client
     walks one credential through prepare -> mint -> show_prove ->
@@ -391,9 +456,18 @@ def run_session_loadgen(
     verdict was False — a correctness alarm, since every minted
     credential must show-verify.
 
-    The engine must already be started; callers own lifecycle."""
+    The engine must already be started; callers own lifecycle.
+
+    transport: "direct" (engine IS a ProtocolEngine) or "rpc" (engine is
+    a net.GatewayClient / net.ReplicaRouter). Over RPC each session gets
+    a stable session id — routed with consistent-hash affinity when the
+    target is a router (its `bound(session)` seam) — and the report adds
+    `rpc_overhead_s` (mean client-observed phase latency minus the
+    engine-side mean, when the replica engines share this process)."""
     if not pool:
         raise ValueError("session loadgen pool must be non-empty")
+    if transport not in ("direct", "rpc"):
+        raise ValueError("unknown transport %r" % (transport,))
     rng = rng if rng is not None else random.Random(0x5E5510)
     lock = threading.Lock()
     session_lat = []
@@ -407,6 +481,7 @@ def run_session_loadgen(
         "failed_shows": 0,
     }
     stages0 = _stage_totals()
+    eng_lat0 = _engine_latency_totals()
     t0 = clock()
     t_end = t0 + duration_s
 
@@ -415,31 +490,38 @@ def run_session_loadgen(
         t_start = clock()
         with lock:
             counts["started"] += 1
+            session_no = counts["started"]
+        if transport == "rpc" and hasattr(engine, "bound"):
+            # a router pins the whole prepare->mint->show flow to the
+            # session's ring-primary replica (consistent-hash affinity)
+            eng = engine.bound("sess-%d" % session_no)
+        else:
+            eng = engine
         phase = SESSION_PHASES[0]
         try:
             t_p = clock()
-            sig_req, _rand = engine.submit_prepare(
+            sig_req, _rand = eng.submit_prepare(
                 messages, elg_pk, lane=lane
             ).result(result_timeout)
             with lock:
                 phase_lat["prepare"].append(clock() - t_p)
             phase = "mint"
             t_p = clock()
-            cred = engine.submit_mint(
+            cred = eng.submit_mint(
                 sig_req, messages, elg_sk, lane=lane
             ).result(result_timeout)
             with lock:
                 phase_lat["mint"].append(clock() - t_p)
             phase = "show_prove"
             t_p = clock()
-            proof, challenge, revealed = engine.submit_show_prove(
+            proof, challenge, revealed = eng.submit_show_prove(
                 cred, messages, lane=lane
             ).result(result_timeout)
             with lock:
                 phase_lat["show_prove"].append(clock() - t_p)
             phase = "show_verify"
             t_p = clock()
-            verdict = engine.submit_show_verify(
+            verdict = eng.submit_show_verify(
                 proof, revealed, challenge, lane=lane
             ).result(result_timeout)
             with lock:
@@ -486,8 +568,10 @@ def run_session_loadgen(
             "goodput_per_s": round(len(lats) / elapsed, 2),
             "latency_s": _percentiles(lats),
         }
+    all_phase_lat = [dt for lats in phase_lat.values() for dt in lats]
     return {
         "arrival": "closed",
+        "transport": transport,
         "duration_s": round(elapsed, 3),
         "concurrency": concurrency,
         "sessions_started": counts["started"],
@@ -498,6 +582,9 @@ def run_session_loadgen(
         "failed_shows": counts["failed_shows"],
         "sessions_per_s": round(counts["completed"] / elapsed, 2),
         "session_latency_s": _percentiles(session_lat),
+        "rpc_overhead_s": _rpc_overhead(
+            transport, all_phase_lat, eng_lat0, _engine_latency_totals()
+        ),
         "per_program": per_program,
         "stage_breakdown_s": _stage_delta(stages0, _stage_totals()),
     }

@@ -58,12 +58,23 @@ class ServeFuture:
     Resolves exactly once, with either a verdict (`set_result`) or an
     exception (`set_exception`); later resolutions are ignored so the
     supervisor's crash-sweep can never clobber a real verdict. `result()`
-    returns the verdict or re-raises the stored exception."""
+    returns the verdict or re-raises the stored exception.
+
+    `add_done_callback` registers a fire-once completion hook (called
+    with the future, on the resolving thread — or immediately on the
+    caller's thread if already resolved): the seam the RPC replica server
+    (coconut_tpu/net/rpc.py) uses to write a response frame the moment
+    the engine settles, without parking a thread per in-flight request.
+    Callback exceptions are contained (counted under
+    "future_callback_errors") so a broken hook can never poison the
+    settling executor thread."""
 
     def __init__(self):
         self._done = threading.Event()
         self._result = None
         self._exc = None
+        self._cb_lock = threading.Lock()
+        self._callbacks = []
         #: trace id of the request this future resolves (None with
         #: tracing disabled) — the join key against trace exports,
         #: flight records, and dead-letter lines
@@ -72,15 +83,37 @@ class ServeFuture:
     def done(self):
         return self._done.is_set()
 
-    def set_result(self, value):
-        if not self._done.is_set():
-            self._result = value
-            self._done.set()
-
-    def set_exception(self, exc):
-        if not self._done.is_set():
+    def _settle(self, result, exc):
+        with self._cb_lock:
+            if self._done.is_set():
+                return
+            self._result = result
             self._exc = exc
             self._done.set()
+            callbacks, self._callbacks = self._callbacks, []
+        for cb in callbacks:
+            self._run_callback(cb)
+
+    def _run_callback(self, cb):
+        try:
+            cb(self)
+        except Exception:
+            metrics.count("future_callback_errors")
+
+    def set_result(self, value):
+        self._settle(value, None)
+
+    def set_exception(self, exc):
+        self._settle(None, exc)
+
+    def add_done_callback(self, fn):
+        """Call `fn(self)` exactly once when the future resolves —
+        immediately (on this thread) if it already has."""
+        with self._cb_lock:
+            if not self._done.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_callback(fn)
 
     def exception(self, timeout=None):
         """The stored exception (None if the future resolved with a

@@ -88,6 +88,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "gateway: fleet-gateway suite (wire-format golden vectors, typed "
+        "error envelopes, per-tenant admission, health gossip, consistent-"
+        "hash routing, replica failover), also run explicitly by ci.sh's "
+        "gateway lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

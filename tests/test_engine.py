@@ -135,9 +135,12 @@ def test_retryable_error_hierarchy():
         assert err.program is not None
         assert err.retry_after_s is not None
 
-    # legacy single-program call sites default both fields to None
+    # legacy single-program call sites default program to None; the
+    # retry-after hint normalizes to 0.0 (PR 13: always a finite float
+    # >= 0, never None — wire envelopes and backoff math rely on it)
     legacy = ServiceOverloadedError(1, 1)
-    assert legacy.program is None and legacy.retry_after_s is None
+    assert legacy.program is None
+    assert legacy.retry_after_s == 0.0
 
 
 # --- online/offline parity -------------------------------------------------
