@@ -81,6 +81,21 @@ BENCH_GATEWAY_SECONDS (default 2), BENCH_GATEWAY_MAX_BATCH (default 4),
 BENCH_GATEWAY_CONCURRENCY (default 2*max_batch); BENCH_GATEWAY=0 skips;
 composes with the other lanes and BENCH_OFFLINE=0.
 
+Lifecycle lane (`python bench.py --lifecycle`, ISSUE 14): the
+warm-restart headline. A predecessor "process" (a simulated-compile
+engine whose per-shape compile wall models BENCH_r05's 130-500 s
+`*_compile_plus_run_s` floor at sub-second scale) serves a shape set,
+drains through a real LifecycleController (shape manifest saved), then
+two successors race to their first SLO-compliant response: COLD (no
+manifest, no persistent compilation cache — every shape pays the full
+wall) vs WARM (manifest replayed through warm_shapes + cache hits).
+Embeds both restart numbers AND the measured compile_plus_run floor
+under "lifecycle"; asserts warm <= BENCH_LIFECYCLE_MAX_FRACTION
+(default 0.5) of cold. Knobs: BENCH_LIFECYCLE_COMPILE_S (default 0.3,
+the per-shape simulated wall), BENCH_LIFECYCLE_SHAPES (default 3);
+BENCH_LIFECYCLE=0 skips; composes with the other lanes and
+BENCH_OFFLINE=0.
+
 Chaos-recovery sub-report (ISSUE 9, on by default with --serve;
 BENCH_CHAOS=0 skips): a three-phase loadgen pass — clean, then one
 injected executor crash + one hung dispatch, then post-fault — against a
@@ -498,6 +513,139 @@ def bench_gateway(ge, params, vk, sigs, msgs_list, extras, backend_name):
     return rpc["goodput_per_s"]
 
 
+def bench_lifecycle(extras):
+    """Warm-restart lane (--lifecycle, ISSUE 14): restart-to-first-SLO-
+    compliant-response, cold vs warm. The compile wall is SIMULATED
+    (BENCH_r05's 130-500 s per-shape `*_compile_plus_run_s` floor scaled
+    to BENCH_LIFECYCLE_COMPILE_S seconds) so the lane runs in CI
+    seconds, but the lifecycle machinery is REAL: a LifecycleController
+    drains the predecessor (manifest saved), the warm successor replays
+    that manifest through engine.warm_shapes with persistent-cache hits,
+    and readiness gates on the replay. Embeds the cold floor, both
+    restart numbers, and their ratio under extras["lifecycle"]; asserts
+    warm <= BENCH_LIFECYCLE_MAX_FRACTION * cold and that the warm
+    successor never pays a full compile wall. Returns the speedup
+    (cold / warm). BENCH_LIFECYCLE=0 skips."""
+    import tempfile
+
+    from coconut_tpu.engine.lifecycle import (
+        LifecycleController,
+        ShapeManifest,
+    )
+
+    compile_s = float(os.environ.get("BENCH_LIFECYCLE_COMPILE_S", "0.3"))
+    n_shapes = int(os.environ.get("BENCH_LIFECYCLE_SHAPES", "3"))
+    max_fraction = float(
+        os.environ.get("BENCH_LIFECYCLE_MAX_FRACTION", "0.5")
+    )
+    #: cache-deserialize cost as a fraction of a full compile — JAX's
+    #: persistent cache loads in seconds what XLA builds in minutes
+    CACHE_HIT_FRACTION, RUN_S = 0.05, 0.002
+    persistent_cache = {}  # the simulated jax_compilation_cache_dir
+
+    class SimCompileEngine:
+        """Every NEW shape pays the compile wall; a persistent-cache hit
+        pays the deserialize fraction. warm_shapes is the manifest-replay
+        seam, exactly like ExecutionEngine's."""
+
+        def __init__(self, name, cache=None):
+            self.name = name
+            self.cache = cache  # None = no persistent cache wired
+            self._compiled = set()
+            self._shapes = set()
+            self.full_walls = 0
+
+        def shape_keys(self):
+            return set(self._shapes)
+
+        def _ensure(self, shape):
+            if shape in self._compiled:
+                return
+            if self.cache is not None and shape in self.cache:
+                time.sleep(compile_s * CACHE_HIT_FRACTION)
+            else:
+                time.sleep(compile_s)
+                self.full_walls += 1
+                if self.cache is not None:
+                    self.cache[shape] = True
+            self._compiled.add(shape)
+
+        def warm_shapes(self, shapes):
+            warmed = 0
+            for prog, placement, shape in shapes:
+                self._ensure(shape)
+                self._shapes.add((prog, placement, shape))
+                warmed += 1
+            return warmed, 0
+
+        def serve_one(self, shape):
+            self._ensure(shape)
+            time.sleep(RUN_S)
+            self._shapes.add(("verify", "single", shape))
+
+        def drain(self, timeout=None):
+            return True
+
+    shapes = [(2 ** i,) for i in range(n_shapes)]
+    manifest_path = os.path.join(
+        tempfile.mkdtemp(prefix="coconut-bench-lifecycle-"), "shapes.json"
+    )
+
+    def restart(name, cache, path):
+        """One successor boot: controller boot (manifest replay when
+        `path` names one) then first response at EVERY serving shape.
+        Returns seconds from restart start to the last first-response —
+        the restart-to-first-SLO-compliant-response number."""
+        eng = SimCompileEngine(name, cache=cache)
+        lc = LifecycleController(eng, manifest_path=path)
+        t0 = time.monotonic()
+        assert lc.boot() is not None and lc.ready()
+        for s in shapes:
+            eng.serve_one(s)
+        return time.monotonic() - t0, eng
+
+    # predecessor: pays the true cold floor, then drains + saves
+    pred = SimCompileEngine("pred", cache=persistent_cache)
+    pred_lc = LifecycleController(pred, manifest_path=manifest_path)
+    pred_lc.boot()
+    t0 = time.monotonic()
+    for s in shapes:
+        pred.serve_one(s)
+    floor_s = time.monotonic() - t0
+    assert pred_lc.begin_drain(timeout=30.0)
+    manifest_shapes = len(ShapeManifest.load(manifest_path))
+    assert manifest_shapes == n_shapes, (
+        "predecessor manifest lost shapes: %d of %d"
+        % (manifest_shapes, n_shapes)
+    )
+
+    # cold: no manifest, no cache — the pre-PR-14 restart experience
+    cold_s, cold_eng = restart("cold", None, None)
+    # warm: manifest replay + persistent-cache hits, readiness gated
+    warm_s, warm_eng = restart("warm", persistent_cache, manifest_path)
+
+    assert cold_eng.full_walls == n_shapes
+    assert warm_eng.full_walls == 0, (
+        "warm successor paid %d full compile walls" % warm_eng.full_walls
+    )
+    assert warm_s <= max_fraction * cold_s, (
+        "warm restart is not cheap enough: %.3fs vs %.3fs cold "
+        "(fraction %.2f > %.2f)"
+        % (warm_s, cold_s, warm_s / cold_s, max_fraction)
+    )
+    extras["lifecycle"] = {
+        "shapes": n_shapes,
+        "simulated_compile_s": compile_s,
+        "compile_plus_run_floor_s": round(floor_s, 4),
+        "cold_restart_to_first_slo_s": round(cold_s, 4),
+        "warm_restart_to_first_slo_s": round(warm_s, 4),
+        "warm_over_cold": round(warm_s / cold_s, 4),
+        "max_fraction": max_fraction,
+        "manifest_shapes": manifest_shapes,
+    }
+    return cold_s / warm_s
+
+
 def _bench_chaos_recovery(params, vk, pool, backend_name, mode, max_batch,
                           max_wait_ms):
     """Self-healing recovery datapoint (ISSUE 9): goodput before / during /
@@ -705,10 +853,18 @@ def main():
         "--gateway" in sys.argv[1:]
         and os.environ.get("BENCH_GATEWAY", "1") == "1"
     )
+    lifecycle_flag = (
+        "--lifecycle" in sys.argv[1:]
+        and os.environ.get("BENCH_LIFECYCLE", "1") == "1"
+    )
     # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
     # offline lanes so the CI online smokes don't pay for them
     offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
-        serve_flag or issue_flag or session_flag or gateway_flag
+        serve_flag
+        or issue_flag
+        or session_flag
+        or gateway_flag
+        or lifecycle_flag
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -769,6 +925,12 @@ def main():
         if value is None:
             value = rpc_goodput
             metric, unit = "gateway_rpc_goodput_per_sec", "requests/sec"
+
+    if lifecycle_flag:
+        speedup = bench_lifecycle(extras)
+        if value is None:
+            value = speedup
+            metric, unit = "lifecycle_warm_restart_speedup", "x"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

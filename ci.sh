@@ -256,6 +256,50 @@ print("gateway smoke: ok (rpc/direct goodput ratio %.2f, "
           report["rpc"]["rpc_overhead_s"] * 1000.0))
 EOF
 
+echo "== lifecycle lane (warm restarts / readiness gating / drain-and-handoff) =="
+# the marker suite: shape-manifest canonicalization + corruption handling,
+# WARMING->UP->DRAINING->CLOSED state machine on a fake clock, graceful
+# drain refusals resubmitted on ring successors, elastic park/unpark
+# hysteresis, and the deterministic loopback rolling-restart drill
+python -m pytest tests/ -m lifecycle -q
+# end-to-end acceptance smoke (ISSUE 14): a REAL 3-replica TCP fleet under
+# continuous loadgen traffic has every replica restarted in sequence —
+# graceful drain persists the shape manifest, the successor boots WARMING,
+# replays it, and rejoins. The probe asserts zero dangling futures, zero
+# non-retryable client errors, the gateway_placed_warming/draining audit
+# counters at ZERO, and bounded restart-to-first-SLO per restart.
+JAX_PLATFORMS=cpu python probes/probe_lifecycle.py
+# warm-restart bench smoke: simulated compile walls behind the manifest +
+# persistent-cache replay; asserted from the JSON artifact a human reads —
+# the ISSUE 14 floor is warm restart-to-first-SLO at a small fraction of
+# the cold compile_plus_run floor (both numbers embedded in the artifact).
+# BENCH_LIFECYCLE=0 skips the lane (e.g. on boxes where the simulated
+# compile sleeps make the wall too noisy to assert on).
+if [ "${BENCH_LIFECYCLE:-1}" = "1" ]; then
+  LIFECYCLE_JSON=$(mktemp -d)/lifecycle.json
+  BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=8 JAX_PLATFORMS=cpu \
+    python bench.py --lifecycle > "$LIFECYCLE_JSON"
+  LIFECYCLE_JSON_PATH="$LIFECYCLE_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["LIFECYCLE_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["lifecycle"]
+assert report["manifest_shapes"] == report["shapes"], report
+assert report["cold_restart_to_first_slo_s"] >= report[
+    "compile_plus_run_floor_s"], report
+assert report["warm_restart_to_first_slo_s"] <= (
+    report["max_fraction"] * report["cold_restart_to_first_slo_s"]), report
+assert report["warm_over_cold"] <= report["max_fraction"], report
+print("lifecycle bench smoke: ok (warm restart %.0f ms vs cold floor "
+      "%.0f ms, warm/cold %.3f)" % (
+          report["warm_restart_to_first_slo_s"] * 1000.0,
+          report["compile_plus_run_floor_s"] * 1000.0,
+          report["warm_over_cold"]))
+EOF
+else
+  echo "lifecycle bench smoke: skipped (BENCH_LIFECYCLE=0)"
+fi
+
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q
 # end-to-end acceptance smoke on the REAL service (CPU, stub backend):

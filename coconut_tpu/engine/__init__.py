@@ -22,6 +22,11 @@ Layout:
   session.py   ProtocolEngine: all five phases registered on ONE engine
                instance — full prepare -> mint -> show-prove ->
                show-verify sessions against a single pool.
+  lifecycle.py Replica lifecycle (PR 14): ShapeManifest persistence,
+               LifecycleController (WARMING -> UP -> DRAINING -> CLOSED
+               with warm-boot manifest replay and readiness gating),
+               and ElasticPolicy/ElasticController (hysteresis-guarded
+               grow/shrink of the executor pool).
 
 serve.CredentialService and issue.IssuanceService are thin program
 registrations on this engine (VerifyProgram and MintProgram); their
@@ -30,6 +35,12 @@ public APIs, metric names, and span shapes are unchanged.
 
 from .core import ExecutionEngine
 from .executor import Executor
+from .lifecycle import (
+    ElasticController,
+    ElasticPolicy,
+    LifecycleController,
+    ShapeManifest,
+)
 from .program import Program
 
 __all__ = [
@@ -37,6 +48,10 @@ __all__ = [
     "Executor",
     "Program",
     "ProtocolEngine",
+    "LifecycleController",
+    "ShapeManifest",
+    "ElasticPolicy",
+    "ElasticController",
 ]
 
 

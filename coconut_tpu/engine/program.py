@@ -115,6 +115,19 @@ class Program:
         except TypeError:
             return (len(requests),)
 
+    def warm(self, shape_key):
+        """Best-effort ahead-of-time priming of ONE jit shape — the
+        lifecycle warmup orchestrator's manifest-replay seam
+        (engine/lifecycle.py). Return True when the shape was actually
+        primed (AOT lower/compile, or a persistent-compilation-cache
+        lookup) so the engine may pre-count it under "%ns_jit_shapes" and
+        the first live dispatch at that shape pays no compile. The
+        default returns False: programs whose dispatch cannot be
+        exercised without live request payloads leave the shape to
+        compile on first dispatch, still served by JAX's persistent
+        compilation cache when configured."""
+        return False
+
     def run_dispatch(self, executor, payload_a, payload_b):
         """Dispatch the assembled batch on `executor`; returns the
         finalizer the engine blocks on in _settle."""

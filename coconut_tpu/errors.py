@@ -207,11 +207,20 @@ class QuorumUnreachableError(ServiceRetryableError):
         self.live = live
 
 
-class ServiceClosedError(CoconutError):
+class ServiceClosedError(ServiceRetryableError):
     """A request was submitted to (or was still queued in) a credential
     service that is draining or shut down (serve/service.py). Futures of
     requests abandoned by a non-draining shutdown resolve with this
-    exception so no caller ever hangs on a dropped future."""
+    exception so no caller ever hangs on a dropped future.
+
+    RETRYABLE over the wire (PR 14): a closing replica is a fleet-level
+    transient — some OTHER replica can serve the request right now, so
+    the router's failover path must treat a closed-replica refusal like a
+    transport failure and resubmit on a ring successor instead of
+    surfacing a terminal error mid-restart. `retry_after_s` defaults to
+    0.0 ("retry elsewhere immediately"); a single-replica caller with
+    nowhere to fail over can still treat it as terminal by checking the
+    `code`."""
 
     code = "closed"
 

@@ -82,6 +82,28 @@ client-facing SLO), "issue_batch_wait_s" (coalescing delay). Gauges:
 "issue_auth<a>_health", "issue_healthy_authorities",
 "issue_queue_depth", "issue_brownout".
 
+The REPLICA LIFECYCLE layer (engine/lifecycle.py, PR 14) reports under
+"lifecycle_*" and "elastic_*": gauges "lifecycle_state" (0 warming /
+1 up / 2 draining / 3 closed), "lifecycle_warmup_s" (boot's manifest
+replay wall time), "lifecycle_manifest_shapes" (shapes loaded at boot);
+counters "lifecycle_warmed_shapes" / "lifecycle_warm_skipped" /
+"lifecycle_warm_errors" (manifest replay outcomes),
+"lifecycle_manifest_corrupt" / "lifecycle_manifest_save_errors" /
+"lifecycle_manifest_unserializable" (artifact integrity — corruption
+degrades to a cold boot, never a failed one), and
+"lifecycle_cache_config_errors" (persistent compilation cache could not
+be configured). Elastic pool sizing: gauges "elastic_active_executors" /
+"elastic_depth" / "elastic_busy_fraction"; counters "elastic_parked" /
+"elastic_unparked" (engine-level park/respawn), "elastic_grown" /
+"elastic_shrunk" (controller decisions that acted), and
+"elastic_emergency_unparked" (parked spares pressed into service when
+every active executor died). The fleet adds the lifecycle routing
+proof: "gateway_warmed" / "gateway_drain_observed" (directory
+transitions), "gateway_drain_handoffs" (closed-replica refusals failed
+over), and per-placement-state "gateway_placed_<state>" — the
+rolling-restart drill asserts "gateway_placed_warming" and
+"gateway_placed_draining" stay zero.
+
 THREAD SAFETY: the serving layer is the first multi-threaded writer
 (admission happens on client threads while the supervisor thread settles
 batches), so every mutation and `snapshot()` runs under one module lock —

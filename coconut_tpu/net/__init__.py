@@ -22,7 +22,15 @@ See README.md "Fleet deployment" for the wire format table, tenant
 knobs, routing policy, and the gateway_*/tenant_* metric glossary.
 """
 
-from .gossip import DEGRADED, DOWN, UP, GossipLoop, HealthDirectory
+from .gossip import (
+    DEGRADED,
+    DOWN,
+    DRAINING,
+    UP,
+    WARMING,
+    GossipLoop,
+    HealthDirectory,
+)
 from .rpc import (
     GatewayClient,
     LoopbackTransport,
@@ -56,8 +64,10 @@ __all__ = [
     "TokenBucket",
     "HealthDirectory",
     "GossipLoop",
+    "WARMING",
     "UP",
     "DEGRADED",
+    "DRAINING",
     "DOWN",
     "ReplicaRouter",
 ]

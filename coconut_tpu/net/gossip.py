@@ -7,24 +7,39 @@ router's GossipLoop polls every replica each interval; the
 HealthDirectory folds the results into a routing view with the SAME
 demotion shape PR 9 gives executors:
 
+  WARMING   registered but not yet proven ready: either no beacon has
+            landed yet (a freshly registered replica starts here — PR 14
+            closed the optimistic-UP hole where a new registration could
+            receive traffic before its first beacon) or the replica's
+            beacon self-reports "warming" (lifecycle warmup: manifest
+            replay / compilation-cache priming still running)
+            -> never receives NEW sessions, not even as a spill target
   UP        beacons arriving, replica reports admissible capacity
   DEGRADED  beacons arriving, but the replica reports itself
             quarantine-level (zero admissible executors) or browned out
             -> demoted for NEW sessions, eligible only as a last-resort
             spill target
+  DRAINING  the replica announced a graceful shutdown (beacon state
+            "draining", or the data path received a retryable
+            closed-replica refusal — note_draining): in-flight work is
+            settling there but NEW sessions must go elsewhere
   DOWN      `miss_threshold` consecutive poll failures (or an explicit
             transport failure reported by the router's data path)
             -> not routed to at all; an in-flight failure there is
             retried on survivors
 
-A DOWN replica rejoins the moment a fresh admissible beacon lands —
-restart-and-readmit needs no operator action, exactly like the
-probation ladder re-admits executors.
+A DOWN or WARMING replica joins/rejoins the moment a fresh admissible
+beacon lands — restart-and-readmit needs no operator action, exactly
+like the probation ladder re-admits executors. A DRAINING replica that
+completes its restart comes back the same way: its successor process
+beacons "warming" then "healthy".
 
 Counters: "gateway_beacons", "gateway_beacon_misses",
-"gateway_demoted", "gateway_readmitted"; gauge "gateway_up_replicas".
-Clock and polling are injectable: fake-clock tests call `step()`
-directly and never sleep.
+"gateway_demoted", "gateway_readmitted", "gateway_warmed" (first
+admissible beacon promoted a WARMING replica), "gateway_drain_observed"
+(a beacon or data-path refusal moved a replica into DRAINING); gauge
+"gateway_up_replicas". Clock and polling are injectable: fake-clock
+tests call `step()` directly and never sleep.
 """
 
 import threading
@@ -32,8 +47,10 @@ import time
 
 from .. import metrics
 
+WARMING = "warming"
 UP = "up"
 DEGRADED = "degraded"
+DRAINING = "draining"
 DOWN = "down"
 
 
@@ -41,7 +58,10 @@ class _ReplicaView:
     __slots__ = ("state", "beacon", "misses", "t_beacon")
 
     def __init__(self):
-        self.state = UP  # optimistic until beacons say otherwise
+        # pessimistic until the first admissible beacon lands: a freshly
+        # registered replica may still be compiling (lifecycle WARMING)
+        # and must not receive traffic on registration alone
+        self.state = WARMING
         self.beacon = None
         self.misses = 0
         self.t_beacon = None
@@ -77,20 +97,32 @@ class HealthDirectory:
         )
 
     def observe(self, beacon, now=None):
-        """Fold one received beacon in; a DOWN/DEGRADED replica whose
-        fresh beacon reports admissible capacity is readmitted."""
+        """Fold one received beacon in; a DOWN/DEGRADED/WARMING replica
+        whose fresh beacon reports admissible capacity is (re)admitted.
+        Lifecycle self-reports map straight through: a beacon stating
+        "warming" or "draining" pins the view to that state regardless of
+        the capacity fields it carries."""
         with self._lock:
             v = self._view(beacon.replica_id)
             was = v.state
             v.beacon = beacon
             v.misses = 0
             v.t_beacon = now
-            degraded = (not beacon.admissible()) or beacon.brownout
-            v.state = DEGRADED if degraded else UP
-            if was != UP and v.state == UP:
+            if beacon.state == "warming":
+                v.state = WARMING
+            elif beacon.state == "draining":
+                v.state = DRAINING
+            else:
+                degraded = (not beacon.admissible()) or beacon.brownout
+                v.state = DEGRADED if degraded else UP
+            if was == WARMING and v.state in (UP, DEGRADED):
+                metrics.count("gateway_warmed")
+            elif was not in (UP, WARMING) and v.state == UP:
                 metrics.count("gateway_readmitted")
             if was == UP and v.state != UP:
                 metrics.count("gateway_demoted")
+            if was != DRAINING and v.state == DRAINING:
+                metrics.count("gateway_drain_observed")
             metrics.count("gateway_beacons")
             self._publish_locked()
 
@@ -116,6 +148,19 @@ class HealthDirectory:
             if v.state != DOWN:
                 v.state = DOWN
                 metrics.count("gateway_demoted")
+            self._publish_locked()
+
+    def note_draining(self, rid):
+        """The router's DATA PATH received a retryable closed-replica
+        refusal from `rid`: it is mid-graceful-shutdown. Softer than
+        note_failure — the replica still answers beacon polls (which will
+        confirm or supersede this), but NEW sessions must stop landing on
+        it NOW, not an interval from now."""
+        with self._lock:
+            v = self._view(rid)
+            if v.state not in (DOWN, DRAINING):
+                v.state = DRAINING
+                metrics.count("gateway_drain_observed")
             self._publish_locked()
 
     def state(self, rid):
@@ -144,8 +189,11 @@ class HealthDirectory:
 
     def usable(self, rid):
         """UP or DEGRADED — the spill pool (DEGRADED beats DOWN: a
-        browned-out replica still answers, a dead one does not)."""
-        return self.state(rid) != DOWN
+        browned-out replica still answers, a dead one does not). WARMING
+        and DRAINING are excluded on purpose: placing a new session on a
+        still-compiling or mid-shutdown replica trades a short spill for
+        a guaranteed slow or refused request."""
+        return self.state(rid) in (UP, DEGRADED)
 
 
 class GossipLoop:

@@ -19,13 +19,21 @@ so the router's job is purely placement quality, not correctness:
              connection, dead loopback) marks the replica DOWN in the
              directory immediately (`note_failure`) and resubmits the
              request on the next candidate under the retry.py ladder —
-             bounded attempts, deterministic jittered backoff. Typed
-             engine refusals (brownout/overload/tenant) are NOT
-             failover triggers: they propagate to the caller, whose
-             backoff the retry_after_s hint already guides.
+             bounded attempts, deterministic jittered backoff. A
+             ServiceClosedError (PR 14: retryable over the wire) is the
+             GRACEFUL twin: the replica is draining, so the directory
+             learns DRAINING (`note_draining` — beacons keep flowing)
+             and the request hands off to a ring successor the same
+             way. Other typed engine refusals (brownout/overload/
+             tenant) are NOT failover triggers: they propagate to the
+             caller, whose backoff the retry_after_s hint already
+             guides.
 
 Counters: "gateway_routed" / "gateway_affinity_hits" / "gateway_spills"
-/ "gateway_failovers" (plus the directory's own gateway_* set).
+/ "gateway_failovers" / "gateway_drain_handoffs" / per-placement-state
+"gateway_placed_<state>" (the rolling-restart drill's proof that no new
+session lands on a WARMING or DRAINING replica), plus the directory's
+own gateway_* set.
 """
 
 import bisect
@@ -33,7 +41,7 @@ import hashlib
 import time
 
 from .. import metrics
-from ..errors import TransientBackendError
+from ..errors import ServiceClosedError, TransientBackendError
 from ..retry import RetryPolicy, call_with_retry
 from . import gossip
 
@@ -73,11 +81,18 @@ class _RoutedFuture:
 
     def result(self, timeout=None):
         first = [True]
+        last_exc = [None]
 
         def attempt():
             if not first[0]:
                 metrics.count("gateway_failovers")
-                self._router.directory.note_failure(self._rid)
+                if isinstance(last_exc[0], ServiceClosedError):
+                    # graceful drain, not a crash: the replica still
+                    # answers beacons — mark DRAINING, not DOWN
+                    metrics.count("gateway_drain_handoffs")
+                    self._router.directory.note_draining(self._rid)
+                else:
+                    self._router.directory.note_failure(self._rid)
                 self._rid, self._fut = self._router._place(
                     self._program,
                     self._args,
@@ -87,7 +102,11 @@ class _RoutedFuture:
                 )
                 self._tried.add(self._rid)
             first[0] = False
-            return self._fut.result(timeout)
+            try:
+                return self._fut.result(timeout)
+            except Exception as e:
+                last_exc[0] = e
+                raise
 
         return call_with_retry(
             attempt, self._router.retry_policy, key=self._session
@@ -172,11 +191,13 @@ class ReplicaRouter:
         self.clock = clock
         # one data-path attempt per replica plus one: a full ring sweep
         # can land back on the (possibly recovered) affinity target
+        # ServiceClosedError rides along (PR 14): a draining replica's
+        # refusal is a handoff trigger, exactly like a torn transport
         self.retry_policy = retry_policy or RetryPolicy(
             max_attempts=len(self.clients) + 1,
             base_delay=0.01,
             max_delay=0.5,
-            retryable=(TransientBackendError,),
+            retryable=(TransientBackendError, ServiceClosedError),
         )
         self.vnodes = vnodes
         self._ring = []
@@ -234,6 +255,11 @@ class ReplicaRouter:
                 chosen = primary  # last resort: everything is DOWN
             metrics.count("gateway_spills")
         metrics.count("gateway_routed")
+        # the drill's audit trail: placements bucketed by the chosen
+        # replica's directory state — "gateway_placed_warming" and
+        # "gateway_placed_draining" staying at ZERO through a rolling
+        # restart is the router-never-misplaces proof
+        metrics.count("gateway_placed_%s" % self.directory.state(chosen))
         return chosen
 
     def _place(self, program, args, lane, session, exclude=()):

@@ -91,6 +91,7 @@ class Replica:
         replica_id="r0",
         clock=time.monotonic,
         result_timeout_s=DEFAULT_RESULT_TIMEOUT_S,
+        lifecycle=None,
     ):
         self.engine = engine
         self.codec = codec
@@ -98,10 +99,15 @@ class Replica:
         self.replica_id = replica_id
         self.clock = clock
         self.result_timeout_s = result_timeout_s
+        #: optional engine.lifecycle.LifecycleController: when present,
+        #: the beacon reports "warming" until boot() finished its
+        #: manifest replay and begin_drain() routes through it
+        self.lifecycle = lifecycle
         self.address = None
         self._srv = None
         self._accept_thread = None
         self._closed = False
+        self._draining = False
         self._conns_lock = threading.Lock()
         self._conns = set()
 
@@ -140,8 +146,18 @@ class Replica:
                 "bulk", depth, primary.queue.max_depth, capacity
             )
         crashed = getattr(eng, "_crashed", None) is not None
+        lc_state = (
+            self.lifecycle.state if self.lifecycle is not None else None
+        )
         if self._closed or crashed:
             state = "down"
+        elif self._draining or lc_state in ("draining", "closed"):
+            # still answering polls — gossip must see DRAINING (settle
+            # in-flight, route new sessions elsewhere), not a miss that
+            # reads as a crash
+            state = "draining"
+        elif lc_state == "warming":
+            state = "warming"
         elif capacity <= 0.0 or (executors and healthy == 0):
             state = "quarantined"
         elif brownout:
@@ -221,8 +237,16 @@ class Replica:
             send(self._error_frame(e, seq, program))
             return
         try:
-            if self._closed:
-                raise ServiceClosedError("replica closed")
+            if self._closed or self._draining:
+                # retryable over the wire (PR 14): the router fails this
+                # over to a ring successor instead of surfacing it
+                raise ServiceClosedError(
+                    "replica %r is %s: resubmit elsewhere"
+                    % (
+                        self.replica_id,
+                        "draining" if self._draining else "closed",
+                    )
+                )
             if self.tenants is not None:
                 self.tenants.admit(api_key, program=program)
             fut = self._submit(program, args, lane)
@@ -349,6 +373,25 @@ class Replica:
                 conn.close()
             except OSError:
                 pass
+
+    def begin_drain(self, timeout=None):
+        """Graceful drain-and-handoff (PR 14): flip the beacon to
+        DRAINING and stop admitting program requests (each refusal is a
+        RETRYABLE ServiceClosedError the router resubmits on a ring
+        successor), keep ANSWERING beacon polls so gossip sees an
+        orderly shutdown rather than a crash, settle every in-flight
+        future via the engine drain (response frames go out as futures
+        settle), then close the listener. `timeout` is ONE deadline
+        shared across the whole drain. Returns True iff the engine
+        drained in time."""
+        self._draining = True
+        if self.lifecycle is not None:
+            ok = self.lifecycle.begin_drain(timeout=timeout)
+        else:
+            drain = getattr(self.engine, "drain", None)
+            ok = bool(drain(timeout=timeout)) if callable(drain) else True
+        self.close()
+        return ok
 
     def close(self):
         """Stop serving: refuse new frames, close the listener and every

@@ -315,8 +315,11 @@ class Beacon:
         """May the router route NEW sessions here? Mirrors the engine's
         executor-admission rule one level up: a replica reporting zero
         admissible executors is demoted exactly like a quarantined
-        executor."""
-        return self.state not in ("quarantined", "down")
+        executor. Lifecycle states (PR 14) are equally inadmissible: a
+        "warming" replica is still replaying its shape manifest and a
+        "draining" one is mid-graceful-shutdown — both refuse or stall
+        new work."""
+        return self.state not in ("quarantined", "down", "warming", "draining")
 
     def as_dict(self):
         return {k: getattr(self, k) for k in self.__slots__}

@@ -59,6 +59,16 @@ request. The full-session driver additionally pins each session to a
 stable session id via the router's `bound(session)` seam, which is what
 exercises consistent-hash affinity end to end.
 
+AVAILABILITY (PR 14): every verify report embeds an "availability"
+section — a per-second goodput/error timeline, `error_free_seconds`,
+and the raw settled-future events — plus an errors split into
+`errors_retryable` (refusals a caller could resubmit: retryable or
+transient types) and `errors_terminal` (everything else). The rolling-
+restart drill asserts `errors_terminal == 0` while replicas cycle, and
+`restart_to_first_slo(report["availability"], t_mark, slo_s)` turns a
+restart timestamp into the restart-to-first-SLO-compliant-response
+number the bench lane asserts on.
+
 Determinism knobs: `rng` (arrival jitter + pool sampling), `clock`, and
 `sleep` are injectable, so tests can drive the generator without
 wall-clock flakiness; the 2-second CI smoke uses the real ones.
@@ -73,6 +83,8 @@ from ..errors import (
     ServiceBrownoutError,
     ServiceClosedError,
     ServiceOverloadedError,
+    ServiceRetryableError,
+    TransientBackendError,
 )
 from ..obs import trace as otrace
 
@@ -182,6 +194,53 @@ def _rpc_overhead(transport, client_latencies, eng0, eng1):
     return round(max(client_mean - d_total / d_count, 0.0), 6)
 
 
+#: availability events embedded per report — enough for any drill, small
+#: enough that a report stays a readable JSON artifact
+_MAX_AVAILABILITY_EVENTS = 20000
+
+
+def _availability(events, t0, elapsed):
+    """The drill's availability section: a per-second goodput/error
+    timeline plus error-free seconds, built from the tally's settled-
+    future events. `events` are (t_absolute, latency_s | None, ok);
+    bucket k covers [k, k+1) seconds after t0."""
+    seconds = max(1, int(elapsed) + (1 if elapsed > int(elapsed) else 0))
+    goodput = [0] * seconds
+    errs = [0] * seconds
+    for t, _lat, ok in events:
+        idx = min(max(int(t - t0), 0), seconds - 1)
+        if ok:
+            goodput[idx] += 1
+        else:
+            errs[idx] += 1
+    out_events = [
+        [round(t - t0, 4), None if lat is None else round(lat, 6), bool(ok)]
+        for t, lat, ok in events[:_MAX_AVAILABILITY_EVENTS]
+    ]
+    return {
+        "seconds": seconds,
+        "per_second_goodput": goodput,
+        "per_second_errors": errs,
+        "error_free_seconds": sum(1 for e in errs if e == 0),
+        "events": out_events,
+        "events_truncated": len(events) > _MAX_AVAILABILITY_EVENTS,
+    }
+
+
+def restart_to_first_slo(availability, t_mark, slo_s):
+    """Seconds from `t_mark` (relative to the run's start, e.g. the
+    moment a replica restart began) to the FIRST completion at/after it
+    whose latency met `slo_s` — the drill's restart-to-first-SLO-
+    compliant-response number. None when no compliant completion
+    followed the mark."""
+    best = None
+    for t, lat, ok in availability["events"]:
+        if ok and lat is not None and t >= t_mark and lat <= slo_s:
+            if best is None or t < best:
+                best = t
+    return None if best is None else max(0.0, best - t_mark)
+
+
 def _percentiles(latencies):
     return {
         "p50": metrics.percentile(latencies, 50),
@@ -203,10 +262,15 @@ class _Tally:
         self.shed = 0
         self.completed = 0
         self.errors = 0
+        self.errors_retryable = 0
+        self.errors_terminal = 0
         self.dropped = 0
         self.valid = 0
         self.invalid = 0
         self.mismatches = 0
+        #: (t_absolute, latency_s | None, ok) per settled future — the
+        #: availability timeline's raw material (drill satellite, PR 14)
+        self.events = []
 
     def settle(self, future, expect_valid, t_submit, clock, timeout):
         """Await one future and fold its outcome in."""
@@ -216,14 +280,29 @@ class _Tally:
             with self.lock:
                 self.dropped += 1
             return
-        except Exception:
+        except Exception as e:
+            now = clock()
+            retryable = isinstance(
+                e, (ServiceRetryableError, TransientBackendError)
+            )
             with self.lock:
                 self.errors += 1
+                if retryable:
+                    # a refusal the caller could resubmit (drain handoff
+                    # that ran out of ring, brownout, overload) — the
+                    # rolling-restart drill asserts the TERMINAL count
+                    # is zero, not this one
+                    self.errors_retryable += 1
+                else:
+                    self.errors_terminal += 1
+                self.events.append((now, None, False))
             return
-        dt = clock() - t_submit
+        now = clock()
+        dt = now - t_submit
         with self.lock:
             self.completed += 1
             self.latencies.append(dt)
+            self.events.append((now, dt, True))
             if verdict:
                 self.valid += 1
             else:
@@ -398,7 +477,10 @@ def run_loadgen(
         "shed": tally.shed,
         "completed": tally.completed,
         "errors": tally.errors,
+        "errors_retryable": tally.errors_retryable,
+        "errors_terminal": tally.errors_terminal,
         "dropped_futures": tally.dropped,
+        "availability": _availability(tally.events, t0, elapsed),
         "valid": tally.valid,
         "invalid": tally.invalid,
         "verdict_mismatches": tally.mismatches,

@@ -1,0 +1,313 @@
+"""Zero-downtime lifecycle acceptance smoke (the PR-14 rolling-restart
+drill over REAL TCP).
+
+    JAX_PLATFORMS=cpu python probes/probe_lifecycle.py
+
+Runs a 3-replica fleet (engine.ProtocolEngine behind net.Replica, real
+loopback TCP sockets, live gossip thread) under continuous mixed
+loadgen traffic, then restarts every replica IN SEQUENCE: graceful
+drain (begin_drain -> shape manifest saved), a fresh engine + replica
+booted through a LifecycleController (beacon reports WARMING until the
+manifest replay finished), rejoin via beacons. Asserts the properties
+ISSUE 14 promises:
+
+  - zero dangling futures and zero NON-RETRYABLE client errors across
+    all three restarts (drain refusals and torn sockets are retryable
+    handoffs the router resubmits on ring successors);
+  - the router provably never places a request on a WARMING or
+    DRAINING replica: the "gateway_placed_warming" and
+    "gateway_placed_draining" audit counters stay at ZERO;
+  - every drain persists a non-empty shape manifest and every
+    successor replays it (warmed + skipped == manifest size) before
+    advertising readiness;
+  - each restart's restart-to-first-SLO-compliant-response, read from
+    the loadgen report's availability timeline, stays bounded.
+
+Prints a one-line JSON report for the CI log. Everything runs on the
+CPU in well under a minute. LIFECYCLE_DRILL_SECONDS stretches the
+traffic window (default 20)."""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from coconut_tpu import metrics, net
+from coconut_tpu.backend import get_backend
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.engine import LifecycleController, ProtocolEngine
+from coconut_tpu.engine.lifecycle import ShapeManifest
+from coconut_tpu.errors import ServiceClosedError, TransientBackendError
+from coconut_tpu.keygen import trusted_party_SSS_keygen
+from coconut_tpu.params import Params
+from coconut_tpu.retry import RetryPolicy
+from coconut_tpu.serve.loadgen import restart_to_first_slo, run_loadgen
+from coconut_tpu.sss import rand_fr
+
+THRESHOLD, TOTAL = 2, 3
+REPLICAS = 3
+FLEET_KEY = "key-fleet"
+DRILL_SECONDS = float(os.environ.get("LIFECYCLE_DRILL_SECONDS", "20"))
+#: generous for a shared CI box — the python backend settles a verify in
+#: well under a second; the bound is "bounded", not "fast"
+SLO_S = 5.0
+
+
+def _mk_engine(signers, params, backend):
+    return ProtocolEngine(
+        signers,
+        params,
+        THRESHOLD,
+        count_hidden=1,
+        revealed_msg_indices=[1, 2],
+        backend=backend,
+        devices=1,
+        max_batch=4,
+        max_wait_ms=5.0,
+    ).start()
+
+
+def _connect(replica, codec):
+    return net.GatewayClient(
+        net.SocketTransport(replica.address), codec, api_key=FLEET_KEY
+    )
+
+
+def _wait(predicate, timeout=15.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.05)
+    assert predicate(), "timed out waiting for %s" % what
+
+
+class _SessionSpread:
+    """run_loadgen drives a single `submit` surface; spread its traffic
+    over many sessions round-robin so every replica owns live flows when
+    its restart comes."""
+
+    def __init__(self, router, n_sessions=24):
+        self._router = router
+        self._sessions = ["drill-%d" % i for i in range(n_sessions)]
+        self._lock = threading.Lock()
+        self._i = 0
+
+    def submit(self, sig, messages, lane="interactive", max_wait_ms=None):
+        with self._lock:
+            session = self._sessions[self._i % len(self._sessions)]
+            self._i += 1
+        return self._router.submit_verify(
+            sig, messages, lane=lane, session=session
+        )
+
+
+def main():
+    metrics.reset()
+    params = Params.new(3, b"probe-lifecycle")
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params)
+    backend = get_backend("python")
+    codec = net.WireCodec(params)
+    tenants = net.TenantTable()
+    tenants.provision("fleet", FLEET_KEY)
+    manifest_dir = tempfile.mkdtemp(prefix="coconut-lifecycle-")
+
+    engines, lifecycles, replicas = {}, {}, {}
+
+    def bring_up(rid):
+        """One replica's boot sequence: WARMING until the manifest
+        replay finished, then serving."""
+        eng = _mk_engine(signers, params, backend)
+        lc = LifecycleController(
+            eng,
+            manifest_path=os.path.join(manifest_dir, "%s.json" % rid),
+        )
+        rep = net.Replica(
+            eng, codec, tenants=tenants, replica_id=rid, lifecycle=lc
+        )
+        rep.serve()
+        engines[rid], lifecycles[rid], replicas[rid] = eng, lc, rep
+        return rep
+
+    for i in range(REPLICAS):
+        bring_up("r%d" % i)
+
+    clients = {rid: _connect(rep, codec) for rid, rep in replicas.items()}
+    router = net.ReplicaRouter(
+        clients,
+        retry_policy=RetryPolicy(
+            max_attempts=REPLICAS + 2,
+            base_delay=0.05,
+            retryable=(TransientBackendError, ServiceClosedError),
+        ),
+    )
+    # first boots are cold (no manifest yet) but still gate readiness
+    for rid, lc in lifecycles.items():
+        assert lc.boot() is not None
+        assert lc.ready(), "%s not ready after boot" % rid
+    loop = net.GossipLoop(
+        router.directory,
+        {
+            rid: (lambda r=rid: router.clients[r].poll_beacon(timeout=2.0))
+            for rid in clients
+        },
+        interval_s=0.1,
+    ).start()
+    _wait(
+        lambda: all(
+            s == net.UP for s in router.directory.states().values()
+        ),
+        what="initial fleet UP",
+    )
+
+    # one real credential for the verify pool
+    msgs = [rand_fr(), rand_fr(), rand_fr()]
+    esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+    req, _ = router.bound("seed").submit_prepare(msgs, epk).result(120.0)
+    cred = router.bound("seed").submit_mint(req, msgs, esk).result(120.0)
+    pool = [(cred, msgs, True)]
+
+    report_box = {}
+    t0 = time.monotonic()
+
+    def drive():
+        report_box["report"] = run_loadgen(
+            _SessionSpread(router),
+            pool,
+            duration_s=DRILL_SECONDS,
+            arrival="closed",
+            concurrency=4,
+            transport="rpc",
+            result_timeout=60.0,
+        )
+
+    loadgen = threading.Thread(target=drive, name="lifecycle-loadgen")
+    loadgen.start()
+
+    restart_marks = {}
+    manifest_sizes = {}
+    warm_totals = {}
+    try:
+        time.sleep(2.0)  # steady state before the first restart
+        for rid in sorted(replicas):
+            restart_marks[rid] = time.monotonic() - t0
+            # 1) graceful drain: in-flight settles, manifest persists
+            assert replicas[rid].begin_drain(timeout=30.0), (
+                "drain of %s timed out" % rid
+            )
+            manifest = ShapeManifest.load(
+                os.path.join(manifest_dir, "%s.json" % rid)
+            )
+            manifest_sizes[rid] = len(manifest)
+            assert len(manifest) >= 1, (
+                "drain of %s saved an empty shape manifest" % rid
+            )
+            _wait(
+                lambda r=rid: router.directory.state(r)
+                in (net.DRAINING, net.DOWN),
+                what="%s leaving the routable pool" % rid,
+            )
+
+            # 2) restart: fresh engine + controller behind the same rid
+            bring_up(rid)
+            old_client = router.clients[rid]
+            router.clients[rid] = _connect(replicas[rid], codec)
+            old_client.close()
+            _wait(
+                lambda r=rid: router.directory.state(r) == net.WARMING,
+                what="%s beaconing WARMING" % rid,
+            )
+
+            # 3) warm boot: replay the predecessor's manifest, then UP
+            warmed, skipped = lifecycles[rid].boot()
+            warm_totals[rid] = (warmed, skipped)
+            assert warmed + skipped == manifest_sizes[rid], (
+                "%s replayed %d+%d of a %d-shape manifest"
+                % (rid, warmed, skipped, manifest_sizes[rid])
+            )
+            _wait(
+                lambda r=rid: router.directory.state(r) == net.UP,
+                what="%s rejoining UP" % rid,
+            )
+        loadgen.join(timeout=DRILL_SECONDS + 90.0)
+        assert not loadgen.is_alive(), "loadgen never finished"
+    finally:
+        loop.stop(timeout=5.0)
+        router.close()
+        for rep in replicas.values():
+            rep.close()
+        for rid, eng in engines.items():
+            eng.drain(timeout=60.0)
+
+    report = report_box["report"]
+    last_mark = max(restart_marks.values())
+    assert report["duration_s"] > last_mark, (
+        "traffic window ended before the last restart — raise "
+        "LIFECYCLE_DRILL_SECONDS (duration %.1fs, last mark %.1fs)"
+        % (report["duration_s"], last_mark)
+    )
+
+    # -- the drill's verdicts -------------------------------------------------
+    placed_warming = metrics.get_count("gateway_placed_warming")
+    placed_draining = metrics.get_count("gateway_placed_draining")
+    recoveries = {
+        rid: restart_to_first_slo(report["availability"], mark, SLO_S)
+        for rid, mark in restart_marks.items()
+    }
+    assert report["dropped_futures"] == 0, "dangling futures in the drill"
+    assert report["errors_terminal"] == 0, (
+        "%d NON-RETRYABLE client errors leaked through the restarts"
+        % report["errors_terminal"]
+    )
+    assert report["completed"] > 0 and report["verdict_mismatches"] == 0
+    assert placed_warming == 0 and placed_draining == 0, (
+        "router placed traffic on a warming/draining replica "
+        "(warming=%d draining=%d)" % (placed_warming, placed_draining)
+    )
+    for rid, rec in recoveries.items():
+        assert rec is not None, (
+            "no SLO-compliant response followed the restart of %s" % rid
+        )
+        assert rec <= 15.0, (
+            "restart of %s took %.1fs to the first SLO-compliant "
+            "response" % (rid, rec)
+        )
+    assert all(
+        s == net.UP for s in router.directory.states().values()
+    ), "fleet did not end fully UP: %s" % (router.directory.states(),)
+
+    out = {
+        "replicas": REPLICAS,
+        "restarts": len(restart_marks),
+        "completed": report["completed"],
+        "errors_retryable": report["errors_retryable"],
+        "errors_terminal": report["errors_terminal"],
+        "dropped_futures": report["dropped_futures"],
+        "drain_handoffs": metrics.get_count("gateway_drain_handoffs"),
+        "failovers": metrics.get_count("gateway_failovers"),
+        "placed_warming": placed_warming,
+        "placed_draining": placed_draining,
+        "warmed_beacons": metrics.get_count("gateway_warmed"),
+        "error_free_seconds": report["availability"]["error_free_seconds"],
+        "seconds": report["availability"]["seconds"],
+        "manifest_shapes": manifest_sizes,
+        "restart_to_first_slo_s": {
+            rid: round(v, 3) for rid, v in recoveries.items()
+        },
+        "p99_s": report["latency_s"]["p99"],
+    }
+    print(json.dumps(out, sort_keys=True))
+    print(
+        "lifecycle probe: ok (%d restarts, %d completed, 0 terminal "
+        "errors, 0 misplaced sessions)"
+        % (out["restarts"], out["completed"])
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

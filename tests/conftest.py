@@ -95,6 +95,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "lifecycle: zero-downtime lifecycle suite (shape-manifest warm "
+        "boot, WARMING/DRAINING readiness gating, drain-and-handoff, "
+        "elastic pool sizing, rolling-restart drill), also run "
+        "explicitly by ci.sh's lifecycle lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

@@ -483,14 +483,19 @@ def _beacon(rid, state="healthy", depth=0, brownout=False):
 def test_directory_transitions():
     metrics.reset()
     d = gossip.HealthDirectory(["r0", "r1"], miss_threshold=2)
-    assert d.states() == {"r0": gossip.UP, "r1": gossip.UP}
-    assert metrics.get_gauge("gateway_up_replicas") == 2
+    # PR 14: fresh registrations start WARMING, not optimistic-UP — a
+    # replica that has never beaconed must not receive traffic
+    assert d.states() == {"r0": gossip.WARMING, "r1": gossip.WARMING}
+    assert metrics.get_gauge("gateway_up_replicas") == 0
+    assert not d.routable("r0") and not d.usable("r0")
 
     d.observe(_beacon("r0", state="quarantined"))
     assert d.state("r0") == gossip.DEGRADED
     assert not d.routable("r0")
     assert d.usable("r0")
-    assert metrics.get_count("gateway_demoted") == 1
+    # WARMING -> DEGRADED is the first beacon landing, not a demotion
+    assert metrics.get_count("gateway_demoted") == 0
+    assert metrics.get_count("gateway_warmed") == 1
 
     d.observe(_beacon("r0", brownout=True))
     assert d.state("r0") == gossip.DEGRADED  # browned-out stays demoted
@@ -500,7 +505,7 @@ def test_directory_transitions():
     assert metrics.get_count("gateway_readmitted") == 1
 
     d.miss("r1")
-    assert d.state("r1") == gossip.UP  # below threshold
+    assert d.state("r1") == gossip.WARMING  # below threshold
     d.miss("r1")
     assert d.state("r1") == gossip.DOWN
     assert not d.usable("r1")
@@ -511,6 +516,36 @@ def test_directory_transitions():
     assert d.state("r1") == gossip.UP
     assert d.queue_depth("r1") == 5
     assert d.queue_depth("rX") == float("inf")
+
+    # lifecycle self-reports pin the view: draining/warming beacons
+    # take the replica out of BOTH the routable and spill pools
+    d.observe(_beacon("r0", state="draining"))
+    assert d.state("r0") == gossip.DRAINING
+    assert not d.routable("r0") and not d.usable("r0")
+    assert metrics.get_count("gateway_drain_observed") == 1
+    d.observe(_beacon("r0", state="warming"))
+    assert d.state("r0") == gossip.WARMING
+    assert not d.routable("r0") and not d.usable("r0")
+    d.observe(_beacon("r0"))
+    assert d.state("r0") == gossip.UP
+
+
+def test_note_draining_soft_demotes():
+    metrics.reset()
+    d = gossip.HealthDirectory(["r0", "r1"], miss_threshold=3)
+    d.observe(_beacon("r0"))
+    assert d.state("r0") == gossip.UP
+    d.note_draining("r0")
+    assert d.state("r0") == gossip.DRAINING
+    assert not d.routable("r0") and not d.usable("r0")
+    # softer than note_failure: no DOWN, and a fresh healthy beacon
+    # (the restarted successor) brings it straight back
+    d.observe(_beacon("r0"))
+    assert d.state("r0") == gossip.UP
+    # note_draining on a DOWN replica must not resurrect it
+    d.note_failure("r1")
+    d.note_draining("r1")
+    assert d.state("r1") == gossip.DOWN
 
 
 def test_note_failure_is_immediate():
