@@ -96,6 +96,18 @@ the per-shape simulated wall), BENCH_LIFECYCLE_SHAPES (default 3);
 BENCH_LIFECYCLE=0 skips; composes with the other lanes and
 BENCH_OFFLINE=0.
 
+Key-lifecycle lane (`python bench.py --keylife`, ISSUE 15): goodput
+before / during / after a live t/n reshare on a 5-authority engine born
+from an online DKG — one proactive refresh plus one 3-of-5 -> 2-of-5
+reshare land mid-traffic on a side thread while the closed-loop verify
+loadgen keeps driving pre-rollover credentials. Embeds the three goodput
+numbers, the during/before degradation ratio, and the after/before
+rollover ratio under "keylife"; asserts the during phase stayed non-zero
+(zero-downtime rollover) and zero dropped futures. Knobs:
+BENCH_KEYLIFE_SECONDS (default 2), BENCH_KEYLIFE_MAX_BATCH (default 4),
+BENCH_KEYLIFE_CONCURRENCY (default 2*max_batch); BENCH_KEYLIFE=0 skips;
+composes with the other lanes and BENCH_OFFLINE=0.
+
 Chaos-recovery sub-report (ISSUE 9, on by default with --serve;
 BENCH_CHAOS=0 skips): a three-phase loadgen pass — clean, then one
 injected executor crash + one hung dispatch, then post-fault — against a
@@ -646,6 +658,140 @@ def bench_lifecycle(extras):
     return cold_s / warm_s
 
 
+def bench_keylife(ge, params, extras, backend_name):
+    """Key-lifecycle lane (--keylife, ISSUE 15): goodput before / during /
+    after a live t/n reshare. A 5-authority engine born from an ONLINE
+    DKG serves closed-loop verify traffic; mid-run the lifecycle takes
+    one proactive refresh AND one 3-of-5 -> 2-of-5 reshare on a side
+    thread while the loadgen keeps driving pre-rollover credentials.
+    Embeds the three goodput numbers, the during/before degradation
+    ratio, and the after/before rollover ratio under extras["keylife"];
+    asserts the during phase stayed NON-ZERO (rollover never blacked out
+    serving) and that zero futures dropped across all three phases.
+    Returns the after-rollover goodput. Knobs: BENCH_KEYLIFE_SECONDS
+    (default 2), BENCH_KEYLIFE_MAX_BATCH (default 4),
+    BENCH_KEYLIFE_CONCURRENCY (default 2*max_batch);
+    BENCH_KEYLIFE=0 skips."""
+    import threading
+
+    from coconut_tpu import metrics
+    from coconut_tpu.elgamal import elgamal_keygen
+    from coconut_tpu.engine import ProtocolEngine
+    from coconut_tpu.keylife import KeyLifecycleManager
+    from coconut_tpu.serve import run_loadgen
+    from coconut_tpu.sss import rand_fr
+
+    seconds = float(os.environ.get("BENCH_KEYLIFE_SECONDS", "2"))
+    max_batch = int(os.environ.get("BENCH_KEYLIFE_MAX_BATCH", "4"))
+    concurrency = int(
+        os.environ.get("BENCH_KEYLIFE_CONCURRENCY", str(2 * max_batch))
+    )
+    threshold, total = 3, 5
+
+    mgr = KeyLifecycleManager(params, label=b"bench-keylife", window=3)
+    ks1 = mgr.bootstrap(threshold, total)
+    revealed = list(range(2, ge.MSG_COUNT))
+    engine = ProtocolEngine(
+        list(ks1.signers), params, threshold,
+        count_hidden=2, revealed_msg_indices=revealed,
+        vk=ks1.vk, backend=backend_name, max_batch=max_batch,
+        keychain=mgr.registry,
+    )
+    mgr.attach(engine)
+
+    class _VerifyFacade:
+        """run_loadgen's verify surface (.submit) over the engine."""
+
+        @staticmethod
+        def submit(sig, messages, lane="interactive"):
+            return engine.submit_verify(sig, messages, lane=lane)
+
+    facade = _VerifyFacade()
+    with engine:
+        # pre-rollover credential pool, minted under epoch 1 — the
+        # traffic the reshare must keep serving
+        pool = []
+        for _ in range(4 * max_batch):
+            msgs = [rand_fr() for _ in range(ge.MSG_COUNT)]
+            esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+            req, _ = engine.submit_prepare(msgs, epk).result(600.0)
+            cred = engine.submit_mint(req, msgs, esk).result(600.0)
+            pool.append((cred, msgs, True))
+        assert all(c.epoch == 1 for c, _m, _e in pool)
+        warm = [
+            facade.submit(*pool[i % len(pool)][:2])
+            for i in range(max_batch)
+        ]
+        for f in warm:
+            f.result(timeout=600.0)
+
+        def phase(duration):
+            return run_loadgen(
+                facade, pool, duration_s=duration,
+                arrival="closed", concurrency=concurrency,
+            )
+
+        before = phase(seconds)
+        rollover_err = []
+
+        def rollover():
+            try:
+                ks1r = mgr.refresh()
+                assert ks1r.vk.to_bytes(params.ctx) == ks1.vk.to_bytes(
+                    params.ctx
+                )
+                mgr.reshare(threshold=2, total=total)
+            except Exception as e:  # pragma: no cover - surfaced below
+                rollover_err.append(e)
+
+        t = threading.Thread(target=rollover, daemon=True)
+        t.start()
+        during = phase(max(seconds, 1.0))
+        t.join(120.0)
+        assert not t.is_alive(), "rollover thread hung under traffic"
+        assert not rollover_err, "rollover failed: %r" % (rollover_err,)
+        after = phase(seconds)
+    for name, rep in (
+        ("before", before), ("during", during), ("after", after)
+    ):
+        assert rep["dropped_futures"] == 0, (
+            "keylife lane %s phase dropped futures: %r" % (name, rep)
+        )
+        assert rep["verdict_mismatches"] == 0, (
+            "keylife lane %s phase verdict mismatch: %r" % (name, rep)
+        )
+    assert during["goodput_per_s"] > 0, (
+        "reshare blacked out serving: %r" % (during,)
+    )
+    degradation = (
+        round(during["goodput_per_s"] / before["goodput_per_s"], 4)
+        if before["goodput_per_s"]
+        else None
+    )
+    extras["keylife"] = {
+        "authorities": total,
+        "threshold_before": threshold,
+        "threshold_after": 2,
+        "max_batch": max_batch,
+        "concurrency": concurrency,
+        "seconds_per_phase": seconds,
+        "goodput_per_s": {
+            "before": before["goodput_per_s"],
+            "during": during["goodput_per_s"],
+            "after": after["goodput_per_s"],
+        },
+        "degradation_ratio": degradation,
+        "rollover_ratio": (
+            round(after["goodput_per_s"] / before["goodput_per_s"], 4)
+            if before["goodput_per_s"]
+            else None
+        ),
+        "refreshes": metrics.get_count("keylife_refreshes"),
+        "reshares": metrics.get_count("keylife_reshares"),
+    }
+    return after["goodput_per_s"]
+
+
 def _bench_chaos_recovery(params, vk, pool, backend_name, mode, max_batch,
                           max_wait_ms):
     """Self-healing recovery datapoint (ISSUE 9): goodput before / during /
@@ -857,6 +1003,10 @@ def main():
         "--lifecycle" in sys.argv[1:]
         and os.environ.get("BENCH_LIFECYCLE", "1") == "1"
     )
+    keylife_flag = (
+        "--keylife" in sys.argv[1:]
+        and os.environ.get("BENCH_KEYLIFE", "1") == "1"
+    )
     # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
     # offline lanes so the CI online smokes don't pay for them
     offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
@@ -865,6 +1015,7 @@ def main():
         or session_flag
         or gateway_flag
         or lifecycle_flag
+        or keylife_flag
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -931,6 +1082,12 @@ def main():
         if value is None:
             value = speedup
             metric, unit = "lifecycle_warm_restart_speedup", "x"
+
+    if keylife_flag:
+        keylife_goodput = bench_keylife(ge, params, extras, backend_name)
+        if value is None:
+            value = keylife_goodput
+            metric, unit = "keylife_rollover_goodput_per_sec", "requests/sec"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

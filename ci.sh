@@ -300,6 +300,50 @@ else
   echo "lifecycle bench smoke: skipped (BENCH_LIFECYCLE=0)"
 fi
 
+echo "== keylife lane (online DKG / proactive refresh / epoch rollover) =="
+# the marker suite: typed share-rejection paths, DKG complaint attribution
+# + typed abort, no-master-secret enforcement, refresh same-verkey/all-
+# shares-change, epoch registry window/pin mechanics, epoch-keyed wire +
+# static-cache coexistence, and the deterministic rollover chaos drill
+python -m pytest tests/ -m keylife -q
+# end-to-end acceptance smoke (ISSUE 15): a REAL 5-authority fleet born
+# from an online DKG (corrupt dealer named + excluded) serves full
+# sessions over a TCP socket while the lifecycle takes one proactive
+# refresh AND one 3-of-5 -> 2-of-5 reshare mid-traffic. The probe asserts
+# zero dangling futures, zero terminal errors, every pre-rollover
+# credential verifying post-rollover under its mint epoch, and the beacon
+# epoch window advertising each transition.
+JAX_PLATFORMS=cpu python probes/probe_epoch.py
+# rollover bench smoke: goodput before/during/after a live reshare,
+# asserted from the JSON artifact a human reads — the ISSUE 15 floor is a
+# NON-ZERO during phase (the rollover never blacks out serving).
+# BENCH_KEYLIFE=0 skips the lane.
+if [ "${BENCH_KEYLIFE:-1}" = "1" ]; then
+  KEYLIFE_JSON=$(mktemp -d)/keylife.json
+  BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=16 BENCH_CHAOS=0 \
+    BENCH_KEYLIFE_SECONDS=1.5 BENCH_KEYLIFE_MAX_BATCH=4 JAX_PLATFORMS=cpu \
+    python bench.py --keylife > "$KEYLIFE_JSON"
+  KEYLIFE_JSON_PATH="$KEYLIFE_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["KEYLIFE_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["keylife"]
+assert report["goodput_per_s"]["during"] > 0, report
+assert report["goodput_per_s"]["before"] > 0, report
+assert report["goodput_per_s"]["after"] > 0, report
+assert report["degradation_ratio"] is not None, report
+assert report["refreshes"] == 1 and report["reshares"] == 1, report
+print("keylife bench smoke: ok (goodput %.1f -> %.1f -> %.1f /s through "
+      "refresh+reshare, degradation %.2f)" % (
+          report["goodput_per_s"]["before"],
+          report["goodput_per_s"]["during"],
+          report["goodput_per_s"]["after"],
+          report["degradation_ratio"]))
+EOF
+else
+  echo "keylife bench smoke: skipped (BENCH_KEYLIFE=0)"
+fi
+
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q
 # end-to-end acceptance smoke on the REAL service (CPU, stub backend):

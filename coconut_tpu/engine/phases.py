@@ -37,13 +37,24 @@ from .program import Program
 
 class ShowOrder:
     """One show-verify submission: the proof plus its Fiat-Shamir
-    challenge (None = recompute from the transcript at assemble time)."""
+    challenge (None = recompute from the transcript at assemble time)
+    and the mint epoch of the credential being shown (None = the boot
+    verkey; PR 15)."""
 
-    __slots__ = ("proof", "challenge")
+    __slots__ = ("proof", "challenge", "epoch")
 
-    def __init__(self, proof, challenge=None):
+    def __init__(self, proof, challenge=None, epoch=None):
         self.proof = proof
         self.challenge = challenge
+        self.epoch = epoch
+
+
+def _group_by_epoch(epochs):
+    """index lists per epoch, preserving arrival order within a group."""
+    groups = {}
+    for i, e in enumerate(epochs):
+        groups.setdefault(e, []).append(i)
+    return groups
 
 
 def _demux_results(requests, results, metric_ns, clock):
@@ -124,7 +135,7 @@ class ShowProveProgram(Program):
 
     def __init__(self, vk, params, revealed_msg_indices, backend=None,
                  max_batch=64, max_wait_ms=20.0, max_depth=1024,
-                 pad_partial=True):
+                 pad_partial=True, keychain=None):
         self.vk = vk
         self.params = params
         self.revealed_msg_indices = list(revealed_msg_indices)
@@ -133,18 +144,49 @@ class ShowProveProgram(Program):
         self.max_wait_ms = max_wait_ms
         self.max_depth = max_depth
         self.pad_partial = pad_partial
+        #: keylife.EpochRegistry: a credential's `epoch` attribute picks
+        #: the verkey its show proof is built against (PR 15)
+        self.keychain = keychain
+
+    def _vk_for(self, epoch):
+        if epoch is None or self.keychain is None:
+            return self.vk
+        return self.keychain.resolve(epoch).vk
 
     def make_dispatch(self, device=None):
         from ..pok_sig import batch_show
 
-        vk, params, revealed, backend = (
-            self.vk, self.params, self.revealed_msg_indices, self.backend,
+        params, revealed, backend = (
+            self.params, self.revealed_msg_indices, self.backend,
         )
 
         def dispatch(sigs, messages_list):
-            out = batch_show(
-                sigs, vk, params, messages_list, revealed, backend=backend
+            if self.keychain is None:
+                out = batch_show(
+                    sigs, self.vk, params, messages_list, revealed,
+                    backend=backend,
+                )
+                return lambda: out
+            # epoch-partitioned: each group proves against ITS epoch's
+            # verkey (one epoch per steady-state batch; rollovers rare)
+            groups = _group_by_epoch(
+                [getattr(s, "epoch", None) for s in sigs]
             )
+            proofs = [None] * len(sigs)
+            challenges = [None] * len(sigs)
+            revealed_out = [None] * len(sigs)
+            for epoch, idxs in groups.items():
+                p, c, rv = batch_show(
+                    [sigs[i] for i in idxs],
+                    self._vk_for(epoch),
+                    params,
+                    [messages_list[i] for i in idxs],
+                    revealed,
+                    backend=backend,
+                )
+                for i, pi, ci, ri in zip(idxs, p, c, rv):
+                    proofs[i], challenges[i], revealed_out[i] = pi, ci, ri
+            out = (proofs, challenges, revealed_out)
             return lambda: out
 
         return dispatch, False
@@ -185,7 +227,8 @@ class ShowVerifyProgram(Program):
     pad_convention = "clone-first-proof"
 
     def __init__(self, vk, params, backend=None, max_batch=64,
-                 max_wait_ms=20.0, max_depth=1024, pad_partial=True):
+                 max_wait_ms=20.0, max_depth=1024, pad_partial=True,
+                 keychain=None):
         self.vk = vk
         self.params = params
         self.backend = backend
@@ -193,18 +236,41 @@ class ShowVerifyProgram(Program):
         self.max_wait_ms = max_wait_ms
         self.max_depth = max_depth
         self.pad_partial = pad_partial
+        #: keylife.EpochRegistry: each ShowOrder's `epoch` picks the
+        #: verkey its proof verifies (and re-hashes) against (PR 15)
+        self.keychain = keychain
+
+    def _vk_for(self, epoch):
+        if epoch is None or self.keychain is None:
+            return self.vk
+        return self.keychain.resolve(epoch).vk
 
     def make_dispatch(self, device=None):
         from ..ps import batch_show_verify
 
-        vk, params, backend = self.vk, self.params, self.backend
+        params, backend = self.params, self.backend
 
         def dispatch(proofs, aux):
-            revealed_list, challenges = aux
-            out = batch_show_verify(
-                proofs, vk, params, revealed_list,
-                challenges=challenges, backend=backend,
-            )
+            revealed_list, challenges = aux[0], aux[1]
+            epochs = aux[2] if len(aux) > 2 else None
+            if epochs is None:
+                out = batch_show_verify(
+                    proofs, self.vk, params, revealed_list,
+                    challenges=challenges, backend=backend,
+                )
+                return lambda: out
+            out = [False] * len(proofs)
+            for epoch, idxs in _group_by_epoch(epochs).items():
+                bits = batch_show_verify(
+                    [proofs[i] for i in idxs],
+                    self._vk_for(epoch),
+                    params,
+                    [revealed_list[i] for i in idxs],
+                    challenges=[challenges[i] for i in idxs],
+                    backend=backend,
+                )
+                for i, b in zip(idxs, bits):
+                    out[i] = bool(b)
             return lambda: out
 
         return dispatch, False
@@ -214,11 +280,21 @@ class ShowVerifyProgram(Program):
 
         proofs = [r.sig.proof for r in requests]
         revealed_list = [dict(r.messages) for r in requests]
+        epochs = (
+            [getattr(r.sig, "epoch", None) for r in requests]
+            if self.keychain is not None
+            else None
+        )
         challenges = [
             r.sig.challenge
             if r.sig.challenge is not None
             else fiat_shamir_challenge(
-                r.sig.proof.to_bytes_for_challenge(self.vk, self.params)
+                r.sig.proof.to_bytes_for_challenge(
+                    # a stranger-verifier transcript re-hash must bind
+                    # the SAME verkey the prover hashed: the mint epoch's
+                    self._vk_for(getattr(r.sig, "epoch", None)),
+                    self.params,
+                )
             )
             for r in requests
         ]
@@ -227,8 +303,12 @@ class ShowVerifyProgram(Program):
             proofs.extend([proofs[0]] * n_pad)
             revealed_list.extend([dict(revealed_list[0])] * n_pad)
             challenges.extend([challenges[0]] * n_pad)
+            if epochs is not None:
+                epochs.extend([epochs[0]] * n_pad)
             metrics.count("showv_pad_lanes", n_pad)
             bspan.set(n_pad=n_pad)
+        if epochs is not None:
+            return proofs, (revealed_list, challenges, epochs)
         return proofs, (revealed_list, challenges)
 
     def demux(self, requests, result, proofs, aux, seq, attempts, bspan):

@@ -76,6 +76,7 @@ class ProtocolEngine(ExecutionEngine):
         brownout=None,
         hedge=None,
         max_redispatch=None,
+        keychain=None,
     ):
         from ..backend import get_backend
 
@@ -104,6 +105,10 @@ class ProtocolEngine(ExecutionEngine):
         self.threshold = threshold
         self.count_hidden = count_hidden
         self.revealed_msg_indices = list(revealed_msg_indices)
+        #: keylife.EpochRegistry (PR 15): epoch-stamped credentials
+        #: resolve their verkey by mint epoch on every phase; None = the
+        #: historical single-verkey engine
+        self.keychain = keychain
 
         common = dict(
             max_batch=max_batch, max_wait_ms=max_wait_ms,
@@ -121,6 +126,7 @@ class ProtocolEngine(ExecutionEngine):
             None,  # retry_policy: bind() installs the no-ladder default
             None,  # fallback_dispatch
             None,  # bisector (grouped-mode only)
+            keychain=keychain,
         )
         self.register(self._verify)  # primary: the pool's seed dispatch
         self._prepare = PrepareProgram(
@@ -129,10 +135,11 @@ class ProtocolEngine(ExecutionEngine):
         )
         self._prove = ShowProveProgram(
             vk, params, self.revealed_msg_indices, backend=backend,
-            pad_partial=pad_partial, **common
+            pad_partial=pad_partial, keychain=keychain, **common
         )
         self._showv = ShowVerifyProgram(
-            vk, params, backend=backend, pad_partial=pad_partial, **common
+            vk, params, backend=backend, pad_partial=pad_partial,
+            keychain=keychain, **common
         )
         for prog in (self._prepare, self._prove, self._showv):
             self.register(prog)
@@ -168,16 +175,35 @@ class ProtocolEngine(ExecutionEngine):
             # disjoint from pool executor labels ("0", "1", ..., "mesh");
             # metrics read "issue_authm1_*" (mint authority 1)
             label_prefix="m",
+            keychain=keychain,
             **common
         )
         self.register(self._mint)
 
         self._finalize_pool(max_redispatch)
 
+    # -- key lifecycle (PR 15) -----------------------------------------------
+
+    def install_keyset(self, keyset):
+        """KeyLifecycleManager hook: new share sets go to the mint
+        program's authorities; verify/show resolve epochs straight off
+        the shared keychain."""
+        self._mint.install_keyset(keyset)
+        self.threshold = self._mint.threshold
+
+    def _check_epoch(self, epoch):
+        """Submit-time pre-validation: an unknown or retired mint epoch
+        refuses typed (EpochUnknownError / EpochRetiredError) BEFORE
+        admission, so the refusal reaches RPC callers through the
+        standard error envelope instead of wasting a batch slot."""
+        if self.keychain is not None and epoch is not None:
+            self.keychain.resolve(epoch)
+
     # -- per-phase submission ------------------------------------------------
 
     def submit_verify(self, sig, messages, lane="interactive",
                       max_wait_ms=None):
+        self._check_epoch(getattr(sig, "epoch", None))
         return self.submit_request(
             "verify", sig, messages, lane=lane, max_wait_ms=max_wait_ms
         )
@@ -208,18 +234,22 @@ class ProtocolEngine(ExecutionEngine):
     def submit_show_prove(self, sig, messages, lane="interactive",
                           max_wait_ms=None):
         """Future resolves to (proof, challenge, revealed_msgs)."""
+        self._check_epoch(getattr(sig, "epoch", None))
         return self.submit_request(
             "show_prove", sig, messages, lane=lane, max_wait_ms=max_wait_ms
         )
 
     def submit_show_verify(self, proof, revealed_msgs, challenge=None,
-                           lane="interactive", max_wait_ms=None):
+                           epoch=None, lane="interactive",
+                           max_wait_ms=None):
         """Future resolves to the show verdict bool. Pass the prover's
         `challenge` to skip the transcript re-hash; None recomputes it
-        (the stranger-verifier path)."""
+        (the stranger-verifier path). `epoch` is the shown credential's
+        mint epoch (None = the boot verkey)."""
+        self._check_epoch(epoch)
         return self.submit_request(
             "show_verify",
-            ShowOrder(proof, challenge),
+            ShowOrder(proof, challenge, epoch=epoch),
             revealed_msgs,
             lane=lane,
             max_wait_ms=max_wait_ms,

@@ -225,6 +225,93 @@ class ServiceClosedError(ServiceRetryableError):
     code = "closed"
 
 
+class ShareVerificationError(GeneralError):
+    """A Pedersen-committed share failed verification against its dealer's
+    coefficient commitments (sss.PedersenVSS.verify_share), or a DVSS/DKG
+    participant refused a structurally-invalid share (own share echoed
+    back, duplicate dealer). Carries `dealer_id` — the authority whose
+    sharing is at fault, the exact-attribution analogue of the issuance
+    path's corrupt-partial naming — and `round`, the key-lifecycle round
+    label ("dkg" / "refresh" / "reshare" / None for offline use) so
+    complaints are auditable. NOT retriable: the same share can never
+    start verifying; the dealer must be excluded."""
+
+    code = "share_rejected"
+
+    def __init__(self, message, dealer_id=None, round=None):
+        super().__init__(message)
+        self.dealer_id = dealer_id
+        self.round = round
+
+
+class DkgAbortedError(ServiceRetryableError):
+    """A distributed key-generation (or proactive refresh / reshare) round
+    could not complete: after excluding dealers named by share-verification
+    complaints and dealers that were unreachable, fewer than `threshold`
+    qualified dealers remain, so no key could be established
+    (coconut_tpu/keylife/dkg.py). RETRIABLE — unreachable authorities
+    usually return (probation ladder, restarts); a later round may
+    succeed. Carries `needed` (the threshold t), `qualified` (dealers
+    that survived complaints), and `excluded` (the sorted ids of dealers
+    named by complaints or unreachable)."""
+
+    code = "dkg_aborted"
+
+    def __init__(
+        self, needed, qualified, excluded=(), program=None, retry_after_s=None
+    ):
+        excluded = tuple(sorted(excluded))
+        super().__init__(
+            "DKG aborted: only %d of %d required qualified dealers remain "
+            "(excluded: %s) — retry once the authority pool recovers"
+            % (qualified, needed, list(excluded) or "none"),
+            program=program,
+            retry_after_s=retry_after_s,
+        )
+        self.needed = needed
+        self.qualified = qualified
+        self.excluded = excluded
+
+
+class EpochUnknownError(CoconutError):
+    """A request named a key epoch this service has never activated (or has
+    not activated YET — a client racing ahead of a rollover). NOT blindly
+    retriable: a future epoch may become valid after the rollover lands,
+    but a fabricated epoch never will, and the service cannot tell which —
+    callers should re-resolve the live epoch set from beacons and resubmit
+    under an advertised epoch. Carries `epoch` and the `live` epoch ids
+    known when refused. Counted under "keylife_epoch_unknown"."""
+
+    code = "epoch_unknown"
+
+    def __init__(self, epoch, live=()):
+        super().__init__(
+            "unknown key epoch %d: this service has epochs %s live — "
+            "re-resolve the epoch set and resubmit" % (epoch, sorted(live))
+        )
+        self.epoch = epoch
+        self.live = tuple(sorted(live))
+
+
+class EpochRetiredError(CoconutError):
+    """A request named a key epoch that existed but has been retired out of
+    the bounded live window (keylife.EpochRegistry): its verkey is no
+    longer served and credentials minted under it can no longer be
+    verified here. NOT retriable — retirement is monotonic; the credential
+    must be re-minted under a live epoch. Carries `epoch` and the `live`
+    epoch ids. Counted under "keylife_epoch_retired"."""
+
+    code = "epoch_retired"
+
+    def __init__(self, epoch, live=()):
+        super().__init__(
+            "key epoch %d is retired: credentials minted under it must be "
+            "re-minted (live epochs: %s)" % (epoch, sorted(live))
+        )
+        self.epoch = epoch
+        self.live = tuple(sorted(live))
+
+
 class TenantAuthError(CoconutError):
     """The gateway (coconut_tpu/net) rejected a request whose API key maps
     to no provisioned tenant. NOT retriable: resubmitting the same key
@@ -286,6 +373,10 @@ WIRE_ERROR_CODES = {
         TenantAuthError,
         TenantQuotaError,
         TenantRateLimitError,
+        ShareVerificationError,
+        DkgAbortedError,
+        EpochUnknownError,
+        EpochRetiredError,
     )
 }
 

@@ -31,6 +31,7 @@ import threading
 from collections import deque
 
 from .. import metrics
+from ..errors import GeneralError
 from ..signature import batch_blind_sign as _batch_blind_sign
 
 
@@ -60,25 +61,52 @@ class SigningAuthority:
         self._closed = False
         self._gen = 0
         self._thread = None
+        #: keylife share store: (epoch, gen) -> (Sigkey, Verkey). The
+        #: boot `signer` share stays the keyset-less default, so the
+        #: historical surface is untouched when no lifecycle runs.
+        self._keys = {}
+
+    # -- key lifecycle -------------------------------------------------------
+
+    def install_keys(self, key, sigkey, verkey):
+        """Install this authority's share for one KeySet — (epoch, gen)
+        keyed, so a refresh's new shares and a reshare's new epoch both
+        land without disturbing fan-outs pinned to older sets."""
+        self._keys[key] = (sigkey, verkey)
+
+    def _share_for(self, keyset):
+        if keyset is None:
+            return self.sigkey
+        entry = self._keys.get(keyset.key)
+        if entry is None:
+            # surfaces as a sign FAULT: the service marks this target
+            # failed and re-covers the fan-out from spares
+            raise GeneralError(
+                "authority %s has no key material for epoch %d gen %d"
+                % (self.label, keyset.epoch, keyset.gen)
+            )
+        return entry[0]
 
     # -- sign dispatch -------------------------------------------------------
 
-    def sign(self, sig_requests, params):
-        """Blind-sign one coalesced batch under this share, pinned to this
-        authority's device when it has one."""
+    def sign(self, sig_requests, params, keyset=None):
+        """Blind-sign one coalesced batch under this share (the boot
+        share, or `keyset`'s installed share), pinned to this authority's
+        device when it has one."""
+        sigkey = self._share_for(keyset)
         if self.device is not None:
             import jax
 
             with jax.default_device(self.device):
-                return self._sign_inner(sig_requests, params)
-        return self._sign_inner(sig_requests, params)
+                return self._sign_inner(sig_requests, params, sigkey)
+        return self._sign_inner(sig_requests, params, sigkey)
 
-    def _sign_inner(self, sig_requests, params):
+    def _sign_inner(self, sig_requests, params, sigkey):
         fn = getattr(self.backend, "batch_blind_sign", None)
         if fn is not None:
-            return fn(sig_requests, self.sigkey, params)
+            return fn(sig_requests, sigkey, params)
         return _batch_blind_sign(
-            sig_requests, self.sigkey, params, backend=self.backend
+            sig_requests, sigkey, params, backend=self.backend
         )
 
     # -- dispatcher side -----------------------------------------------------

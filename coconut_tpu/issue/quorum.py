@@ -72,9 +72,12 @@ class Fanout:
         "resolved",
         "minting",
         "quorum_at",
+        "keyset",
+        "threshold",
     )
 
-    def __init__(self, fid, requests, sig_reqs, messages_list, sks, bspan, now):
+    def __init__(self, fid, requests, sig_reqs, messages_list, sks, bspan, now,
+                 keyset=None, threshold=None):
         self.fid = fid
         self.requests = requests
         self.sig_reqs = sig_reqs
@@ -93,6 +96,13 @@ class Fanout:
         self.resolved = False  # every request settled; late rows are stale
         self.minting = False  # a thread is inside the mint path right now
         self.quorum_at = None
+        #: key-lifecycle pin (keylife.KeySet) this fan-out mints under —
+        #: fixed at open so a mid-flight refresh/reshare never mixes
+        #: partials from different sharings; None on the boot-keys path
+        self.keyset = keyset
+        #: quorum size for THIS fan-out (a reshare may change t for
+        #: later fan-outs; in-flight ones keep the t they opened with)
+        self.threshold = threshold
 
     def available_ids(self):
         """Contributing signer ids still usable for aggregation, in
@@ -130,14 +140,15 @@ class QuorumTracker:
                 return None
             fanout.partials[signer_id] = partials
             fanout.order.append(signer_id)
+            t = fanout.threshold or self.threshold
             usable = len(fanout.available_ids())
-            if usable < self.threshold or fanout.minting:
+            if usable < t or fanout.minting:
                 return None
             fanout.minting = True
             if fanout.quorum_at is None:
                 fanout.quorum_at = now
                 metrics.observe("issue_quorum_wait_s", now - fanout.t_dispatch)
-            return fanout.available_ids()[: self.threshold]
+            return fanout.available_ids()[:t]
 
     def drop_partials(self, fanout, signer_ids):
         """Attribution verdict: these authorities' rows are corrupt —
@@ -153,9 +164,10 @@ class QuorumTracker:
         with self._lock:
             if fanout.resolved:
                 return None
+            t = fanout.threshold or self.threshold
             ids = fanout.available_ids()
-            if len(ids) >= self.threshold:
-                return ids[: self.threshold]
+            if len(ids) >= t:
+                return ids[:t]
             fanout.minting = False  # wait for more rows to land
             return None
 

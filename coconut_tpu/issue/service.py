@@ -99,6 +99,7 @@ class MintProgram(Program):
         max_wait_ms=20.0,
         max_depth=1024,
         label_prefix="",
+        keychain=None,
     ):
         signers = list(signers)
         if not signers:
@@ -130,6 +131,12 @@ class MintProgram(Program):
         self._minter = minter
         self._hedge = hedge
         self._label_prefix = label_prefix
+        #: keylife.EpochRegistry (PR 15): when set, every fan-out pins
+        #: the ACTIVE KeySet at open and mints under it start to finish,
+        #: minted credentials carry their epoch, and a mid-flight
+        #: refresh/reshare never disturbs in-flight work. None = the
+        #: historical frozen-at-boot path, byte for byte.
+        self.keychain = keychain
 
     def bind(self, engine):
         super().bind(engine)
@@ -164,6 +171,7 @@ class MintProgram(Program):
             )
         )
         self._tracker = QuorumTracker(self.threshold, clock=engine.clock)
+        self._minters = {}  # (epoch, gen) -> CryptoMinter for that KeySet
         self.hedge_policy = (
             self._hedge if self._hedge is not None else HedgePolicy()
         )
@@ -210,6 +218,40 @@ class MintProgram(Program):
                 if self._health_of(a.label).admissible()
             ),
         )
+
+    # -- key lifecycle (PR 15) -----------------------------------------------
+
+    def install_keyset(self, keyset):
+        """Install one keylife.KeySet: each authority gets ITS share from
+        the set's signers, and a per-set CryptoMinter (per-signer verkeys
+        for attribution, aggregated-verkey cache for the release gate)
+        is readied. Called by KeyLifecycleManager BEFORE the epoch
+        activates, so the instant fan-outs start pinning it every
+        authority can already sign under it. A reshare's new quorum size
+        takes effect for fan-outs opened from then on; in-flight ones
+        carry the threshold they pinned."""
+        for auth in self._authorities:
+            s = keyset.signer(auth.id)
+            if s is not None:
+                auth.install_keys(keyset.key, s.sigkey, s.verkey)
+        self._minters[keyset.key] = CryptoMinter(
+            keyset.threshold,
+            keyset.verkeys_by_id(),
+            self.params,
+            backend=self._backend,
+        )
+        self.threshold = keyset.threshold
+
+    def _minter_for(self, keyset):
+        if keyset is None:
+            return self.minter
+        m = self._minters.get(keyset.key)
+        if m is None:
+            raise GeneralError(
+                "no minter installed for epoch %d gen %d"
+                % (keyset.epoch, keyset.gen)
+            )
+        return m
 
     def start_workers(self):
         for auth in self._authorities:
@@ -380,7 +422,10 @@ class MintProgram(Program):
                     f,
                     pending,
                     QuorumUnreachableError(
-                        self.threshold, have, live=0, program=self.name
+                        f.threshold or self.threshold,
+                        have,
+                        live=0,
+                        program=self.name,
                     ),
                 )
             self._close_fanout(f, result="swept")
@@ -428,6 +473,16 @@ class MintProgram(Program):
                 counter="issue_failed_requests",
             )
             return
+        keyset = None
+        if self.keychain is not None:
+            # pin AFTER the early-fail paths so every pin has a matching
+            # unpin in _close_fanout; the pin holds this KeySet's epoch
+            # out of retirement until the fan-out closes
+            try:
+                keyset = self.keychain.pin_active()
+            except GeneralError as e:
+                fail_all(requests, e, counter="issue_failed_requests")
+                return
         bspan = otrace.start_span(
             "issue_batch",
             root=True,
@@ -449,6 +504,8 @@ class MintProgram(Program):
             [r.sig.elgamal_sk for r in requests],
             bspan,
             now,
+            keyset=keyset,
+            threshold=keyset.threshold if keyset is not None else None,
         )
         self._tracker.open(f)
         metrics.observe(
@@ -505,6 +562,7 @@ class MintProgram(Program):
         dispatch spares to close any gap ("issue_redispatched"), and when
         no spare can close it, fail the fan-out's unresolved requests
         with the typed, retriable QuorumUnreachableError."""
+        t = fanout.threshold or self.threshold
         while True:
             if fanout.resolved:
                 return
@@ -517,7 +575,7 @@ class MintProgram(Program):
                     and a.id not in fanout.partials
                     and a.id not in fanout.dropped
                 )
-            if have + inflight >= self.threshold:
+            if have + inflight >= t:
                 return
             spare = self._pick_spare(fanout)
             if spare is None:
@@ -535,9 +593,7 @@ class MintProgram(Program):
         self._fail_requests(
             fanout,
             pending,
-            QuorumUnreachableError(
-                self.threshold, have, live=have, program=self.name
-            ),
+            QuorumUnreachableError(t, have, live=have, program=self.name),
         )
         if self._tracker.settle(fanout, pending):
             self._close_fanout(fanout, result="unreachable")
@@ -560,7 +616,9 @@ class MintProgram(Program):
         t0 = self.engine.clock()
         try:
             with metrics.timer(auth.busy_timer):
-                partials = auth.sign(fanout.sig_reqs, self.params)
+                partials = auth.sign(
+                    fanout.sig_reqs, self.params, keyset=fanout.keyset
+                )
         except Exception as e:
             # sign FAULT (not a crash — the worker survives): mark this
             # target failed, breaker the authority, restore coverage
@@ -604,14 +662,15 @@ class MintProgram(Program):
         ]
         sks = [fanout.sks[idx] for idx in indices]
         messages_list = [fanout.messages_list[idx] for idx in indices]
+        minter = self._minter_for(fanout.keyset)
         try:
             with otrace.use(fanout.bspan):
                 with otrace.span("unblind", n=len(indices), t=len(subset)):
-                    sig_rows = self.minter.unblind(blind_rows, sks)
+                    sig_rows = minter.unblind(blind_rows, sks)
                 with otrace.span("aggregate", subset=list(subset)):
-                    creds = self.minter.aggregate(subset, sig_rows)
+                    creds = minter.aggregate(subset, sig_rows)
                 with otrace.span("verify", n=len(indices)):
-                    verdicts = self.minter.verify(
+                    verdicts = minter.verify(
                         creds, messages_list, subset
                     )
         except Exception as e:
@@ -653,7 +712,7 @@ class MintProgram(Program):
             for j, signer_id in enumerate(subset):
                 if signer_id in culprits:
                     continue
-                if not self.minter.verify_partial(signer_id, row[j], msgs):
+                if not minter.verify_partial(signer_id, row[j], msgs):
                     culprits.add(signer_id)
         if not culprits:
             # every partial checks out yet the aggregate does not: the
@@ -694,11 +753,17 @@ class MintProgram(Program):
         credential leaves the service on, and it is behind the verify
         gate by construction."""
         now = self.engine.clock()
+        epoch = fanout.keyset.epoch if fanout.keyset is not None else None
         for idx in indices:
             r = fanout.requests[idx]
+            cred = creds_by_idx[idx]
+            if epoch is not None:
+                # the credential's mint epoch rides with it (and over the
+                # wire): verify resolves the aggregated verkey by epoch
+                cred.epoch = epoch
             metrics.observe("issue_latency_s", now - r.t_submit)
             r.span.end(verdict=True)
-            r.future.set_result(creds_by_idx[idx])
+            r.future.set_result(cred)
         metrics.count("issue_minted", len(indices))
 
     def _fail_requests(self, fanout, indices, exc):
@@ -717,6 +782,12 @@ class MintProgram(Program):
         deadline too; one mid-sign finishes and ends its own)."""
         self._tracker.close_fanout(fanout)
         self._hedges.cancel(fanout.fid)
+        with self._flock:
+            # swap-then-unpin so a double close (sweep racing a late
+            # settle) never unpins twice
+            keyset, fanout.keyset = fanout.keyset, None
+        if keyset is not None and self.keychain is not None:
+            self.keychain.unpin(keyset)
         now = self.engine.clock()
         for auth in self._authorities:
             if auth.cancel(fanout.fid):
@@ -762,6 +833,7 @@ class IssuanceService(ExecutionEngine):
         watchdog_interval_s=0.25,
         hedge=None,
         brownout=None,
+        keychain=None,
     ):
         super().__init__(
             name="coconut-issue",
@@ -785,6 +857,7 @@ class IssuanceService(ExecutionEngine):
             max_batch=max_batch,
             max_wait_ms=max_wait_ms,
             max_depth=max_depth,
+            keychain=keychain,
         )
         self.register(self._program)
         self.params = params
@@ -811,6 +884,16 @@ class IssuanceService(ExecutionEngine):
             lane=lane,
             max_wait_ms=max_wait_ms,
         )
+
+    # -- key lifecycle (PR 15) -----------------------------------------------
+
+    @property
+    def keychain(self):
+        return self._program.keychain
+
+    def install_keyset(self, keyset):
+        self._program.install_keyset(keyset)
+        self.threshold = self._program.threshold
 
     # -- historical surface (delegating to the mint program) -----------------
 

@@ -1,0 +1,32 @@
+"""Dealerless key lifecycle: online DKG, epoch registry, proactive
+refresh and t/n reshare with zero-downtime rollover (PR 15; ROADMAP
+item 4). See README "Key lifecycle & epochs"."""
+
+from .dkg import DkgResult, run_dkg, run_refresh
+from .epoch import (
+    ACTIVE,
+    EPOCH_STATE_CODES,
+    EPOCH_STATE_OF_CODE,
+    PENDING,
+    RETIRED,
+    RETIRING,
+    EpochRegistry,
+    KeySet,
+)
+from .manager import KeyLifecycleManager, aggregate_vk
+
+__all__ = [
+    "ACTIVE",
+    "DkgResult",
+    "EPOCH_STATE_CODES",
+    "EPOCH_STATE_OF_CODE",
+    "EpochRegistry",
+    "KeyLifecycleManager",
+    "KeySet",
+    "PENDING",
+    "RETIRED",
+    "RETIRING",
+    "aggregate_vk",
+    "run_dkg",
+    "run_refresh",
+]

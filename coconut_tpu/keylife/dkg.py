@@ -1,0 +1,221 @@
+"""Online DKG and proactive refresh rounds (PR 15).
+
+`dvss_keygen` (keygen.py) is the reference's in-process driver: it sums
+every participant's dealt secret into a master secret, which is exactly
+what a deployment must never do — only the test alias
+`setup_signers_for_test` may aggregate in-process. The drivers here are
+the online promotion of that protocol:
+
+  run_dkg      Gennaro-style DKG: every authority deals a Pedersen-VSS
+               sharing of a fresh random secret per key dimension
+               (1 for x, one per attribute for the y's); recipients
+               verify each share against the dealer's coefficient
+               commitments and COMPLAIN — naming the dealer exactly, the
+               corrupt-partial attribution pattern from issue/ — on
+               mismatch. Disqualified (complained-against or
+               unreachable) dealers are excluded and the key is the sum
+               over the QUAL set only. If fewer than `threshold` honest
+               dealers remain the round aborts with the typed, wired,
+               retryable DkgAbortedError. No code path reconstructs the
+               master secret: per-recipient share sums are the only
+               aggregation performed.
+
+  run_refresh  Herzberg-style proactive refresh: every QUAL dealer deals
+               a verifiable sharing of ZERO (PedersenVSS.deal_zero) and
+               publishes the degree-0 blinding so recipients can check
+               the zero-opening comm[0] == h^{b0} — without that check a
+               corrupt dealer could shift the shared secret and silently
+               change the verkey. New share = old share + sum of zero
+               shares: every share changes, the secret (and the
+               aggregated verkey, bit for bit) does not.
+
+A t/n-changing reshare is run_dkg with the new parameters — a fresh
+secret under a fresh epoch, not a transformation of the old one, so a
+compromise of the old epoch's shares never taints the new.
+
+Transport is synchronous and in-process (the fleet drill drives real
+authorities over CTS-RPC for everything *around* the round); the
+`unreachable` and `tamper` hooks inject the faults the chaos drill
+needs deterministically.
+"""
+
+from collections import namedtuple
+
+from ..errors import DkgAbortedError, ShareVerificationError
+from ..keygen import keygen_from_shares
+from ..ops.fields import R
+from ..sss import PedersenVSS
+
+#: Outcome of a DKG or refresh round. Deliberately carries NO secret
+#: aggregate — only per-signer key material (inside Signer objects) and
+#: the dealer audit trail. test_keylife pins this.
+DkgResult = namedtuple(
+    "DkgResult",
+    ["signers", "qual", "excluded", "complaints", "threshold", "total"],
+)
+
+
+def _maybe_tamper(tamper, dealer_id, recipient_id, dim, share):
+    if tamper is None:
+        return share
+    out = tamper(dealer_id, recipient_id, dim, share)
+    return share if out is None else out
+
+
+def run_dkg(threshold, total, params, g, h, round="dkg",
+            unreachable=(), tamper=None):
+    """One full DKG round over `1 + params.msg_count()` key dimensions.
+
+    `unreachable` — dealer ids that never deal (crashed/partitioned).
+    `tamper(dealer_id, recipient_id, dim, (s, t))` — fault hook: return a
+    replacement share to corrupt that one delivery (None = honest).
+
+    Returns a DkgResult whose signers hold shares of the summed QUAL
+    secret; raises DkgAbortedError when |QUAL| < threshold.
+    """
+    dims = 1 + params.msg_count()
+    unreachable = set(unreachable)
+    all_ids = list(range(1, total + 1))
+    dealers = [i for i in all_ids if i not in unreachable]
+
+    # Deal phase: every reachable dealer commits one sharing per dimension.
+    deals = {}  # dealer_id -> [(comm_coeffs, s_shares, t_shares)] per dim
+    for d in dealers:
+        per_dim = []
+        for _ in range(dims):
+            _, _, comm, s_shares, t_shares = PedersenVSS.deal(
+                threshold, total, g, h
+            )
+            per_dim.append((comm, s_shares, t_shares))
+        deals[d] = per_dim
+
+    # Verification phase: each recipient checks every delivered share
+    # against the dealer's commitments; a failed check is a complaint
+    # naming that dealer. One verifiable complaint disqualifies.
+    complaints = {}  # dealer_id -> sorted recipient ids
+    for d in dealers:
+        for r in all_ids:
+            for dim in range(dims):
+                comm, s_shares, t_shares = deals[d][dim]
+                share = _maybe_tamper(
+                    tamper, d, r, dim, (s_shares[r], t_shares[r])
+                )
+                try:
+                    PedersenVSS.check_share(
+                        threshold, r, share, comm, g, h,
+                        dealer_id=d, round=round,
+                    )
+                except ShareVerificationError:
+                    complaints.setdefault(d, set()).add(r)
+                else:
+                    deals[d][dim] = (comm, dict(s_shares), t_shares)
+                    deals[d][dim][1][r] = share[0]
+    complaints = {d: tuple(sorted(rs)) for d, rs in complaints.items()}
+
+    excluded = unreachable | set(complaints)
+    qual = [i for i in all_ids if i not in excluded]
+    if len(qual) < threshold:
+        raise DkgAbortedError(threshold, len(qual), excluded=excluded)
+
+    # Key derivation: per-recipient sums over QUAL dealers ONLY — the one
+    # aggregation this path performs. Every authority 1..total receives
+    # key shares (an excluded DEALER still serves as a share RECIPIENT).
+    def summed(dim):
+        return {
+            r: sum(deals[d][dim][1][r] for d in qual) % R for r in all_ids
+        }
+
+    x_shares = summed(0)
+    y_shares = [summed(1 + j) for j in range(dims - 1)]
+    signers = keygen_from_shares(total, x_shares, y_shares, params)
+    return DkgResult(
+        signers=signers,
+        qual=tuple(qual),
+        excluded=tuple(sorted(excluded)),
+        complaints=complaints,
+        threshold=threshold,
+        total=total,
+    )
+
+
+def run_refresh(signers, threshold, params, g, h, round="refresh",
+                unreachable=(), tamper=None):
+    """One proactive refresh round over an existing sharing.
+
+    Every reachable authority deals a zero-sharing per dimension and
+    publishes its degree-0 blinding; recipients enforce BOTH the usual
+    share check and the zero-opening comm[0] == h^{b0} (a dealer passing
+    the first but not the second is shifting the secret — complained
+    against and excluded). New share_i = old share_i + Σ_QUAL zero
+    share_i. Same hooks and abort semantics as run_dkg; returns a
+    DkgResult whose signers' verkeys aggregate to the SAME verkey.
+    """
+    dims = 1 + params.msg_count()
+    total = len(signers)
+    by_id = {s.id: s for s in signers}
+    unreachable = set(unreachable)
+    all_ids = sorted(by_id)
+    dealers = [i for i in all_ids if i not in unreachable]
+    ops = PedersenVSS.ops
+
+    deals = {}  # dealer_id -> [(blind0, comm_coeffs, s_shares, t_shares)]
+    for d in dealers:
+        per_dim = []
+        for _ in range(dims):
+            blind0, comm, s_shares, t_shares = PedersenVSS.deal_zero(
+                threshold, total, g, h
+            )
+            per_dim.append((blind0, comm, s_shares, t_shares))
+        deals[d] = per_dim
+
+    complaints = {}
+    for d in dealers:
+        for r in all_ids:
+            for dim in range(dims):
+                blind0, comm, s_shares, t_shares = deals[d][dim]
+                share = _maybe_tamper(
+                    tamper, d, r, dim, (s_shares[r], t_shares[r])
+                )
+                ok = comm[0] == ops.mul(h, blind0)
+                if ok:
+                    try:
+                        PedersenVSS.check_share(
+                            threshold, r, share, comm, g, h,
+                            dealer_id=d, round=round,
+                        )
+                    except ShareVerificationError:
+                        ok = False
+                if not ok:
+                    complaints.setdefault(d, set()).add(r)
+                else:
+                    deals[d][dim] = (blind0, comm, dict(s_shares), t_shares)
+                    deals[d][dim][2][r] = share[0]
+    complaints = {d: tuple(sorted(rs)) for d, rs in complaints.items()}
+
+    excluded = unreachable | set(complaints)
+    qual = [i for i in all_ids if i not in excluded]
+    if len(qual) < threshold:
+        raise DkgAbortedError(threshold, len(qual), excluded=excluded)
+
+    def delta(dim):
+        return {
+            r: sum(deals[d][dim][2][r] for d in qual) % R for r in all_ids
+        }
+
+    dx = delta(0)
+    x_shares = {r: (by_id[r].sigkey.x + dx[r]) % R for r in all_ids}
+    y_shares = []
+    for j in range(dims - 1):
+        dy = delta(1 + j)
+        y_shares.append(
+            {r: (by_id[r].sigkey.y[j] + dy[r]) % R for r in all_ids}
+        )
+    new_signers = keygen_from_shares(total, x_shares, y_shares, params)
+    return DkgResult(
+        signers=new_signers,
+        qual=tuple(qual),
+        excluded=tuple(sorted(excluded)),
+        complaints=complaints,
+        threshold=threshold,
+        total=total,
+    )

@@ -171,6 +171,17 @@ class HealthDirectory:
         with self._lock:
             return self._views[rid].beacon if rid in self._views else None
 
+    def epochs(self, rid):
+        """Live (epoch_id, state) pairs `rid` last advertised (wire v2
+        beacons; () when no beacon has landed or the replica runs no key
+        lifecycle) — the router's view of which mint epochs still verify
+        there."""
+        with self._lock:
+            v = self._views.get(rid)
+            if v is None or v.beacon is None:
+                return ()
+            return tuple(getattr(v.beacon, "epochs", ()) or ())
+
     def queue_depth(self, rid):
         """Last-beacon queue depth (the least-loaded spill key); unknown
         replicas sort last."""

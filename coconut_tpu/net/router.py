@@ -161,10 +161,11 @@ class _SessionClient:
         )
 
     def submit_show_verify(self, proof, revealed_msgs, challenge=None,
-                           lane="interactive", max_wait_ms=None):
+                           epoch=None, lane="interactive",
+                           max_wait_ms=None):
         return self._router.submit_show_verify(
-            proof, revealed_msgs, challenge=challenge, lane=lane,
-            session=self.session,
+            proof, revealed_msgs, challenge=challenge, epoch=epoch,
+            lane=lane, session=self.session,
         )
 
 
@@ -303,10 +304,11 @@ class ReplicaRouter:
         return self._submit("show_prove", (sig, messages), lane, session)
 
     def submit_show_verify(self, proof, revealed_msgs, challenge=None,
-                           lane="interactive", max_wait_ms=None,
-                           session=""):
+                           epoch=None, lane="interactive",
+                           max_wait_ms=None, session=""):
         return self._submit(
-            "show_verify", (proof, revealed_msgs, challenge), lane, session
+            "show_verify", (proof, revealed_msgs, challenge, epoch),
+            lane, session,
         )
 
     def bound(self, session):

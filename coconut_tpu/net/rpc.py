@@ -145,6 +145,13 @@ class Replica:
             brownout, _ = eng._brownout.check(
                 "bulk", depth, primary.queue.max_depth, capacity
             )
+        # wire v2: advertise the live key-epoch window when the engine
+        # runs a key lifecycle (routers learn which mint epochs verify
+        # here); a keychain-less engine advertises the empty window
+        keychain = getattr(eng, "keychain", None)
+        epochs = (
+            tuple(keychain.live_epochs()) if keychain is not None else ()
+        )
         crashed = getattr(eng, "_crashed", None) is not None
         lc_state = (
             self.lifecycle.state if self.lifecycle is not None else None
@@ -173,6 +180,7 @@ class Replica:
             healthy_executors=healthy,
             executors=len(executors),
             t=now,
+            epochs=epochs,
         )
 
     # -- request handling ----------------------------------------------------
@@ -653,10 +661,11 @@ class GatewayClient:
         return self._submit("show_prove", (sig, messages), lane, session)
 
     def submit_show_verify(self, proof, revealed_msgs, challenge=None,
-                           lane="interactive", max_wait_ms=None,
-                           session=None):
+                           epoch=None, lane="interactive",
+                           max_wait_ms=None, session=None):
         return self._submit(
-            "show_verify", (proof, revealed_msgs, challenge), lane, session
+            "show_verify", (proof, revealed_msgs, challenge, epoch),
+            lane, session,
         )
 
     def poll_beacon(self, timeout=5.0):

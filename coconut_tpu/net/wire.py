@@ -41,13 +41,18 @@ by the server) rather than producing a half-parsed request.
 import struct
 
 from ..errors import DeserializationError, error_from_wire
+from ..keylife.epoch import EPOCH_STATE_CODES, EPOCH_STATE_OF_CODE
 from ..ops import serialize as ser
 from ..serve.queue import LANES
 
 #: bump when the frame layout or any payload encoding changes; decoders
 #: reject every version they were not built for (explicit skew failure
-#: beats silent misparsing)
-WIRE_VERSION = 1
+#: beats silent misparsing).
+#: v2 (PR 15): mint epochs on the wire — verify/show_prove/show_verify
+#: requests and the mint response carry a u32 epoch (0 = unpinned, the
+#: pre-lifecycle boot verkey), and beacons advertise the replica's live
+#: epoch window.
+WIRE_VERSION = 2
 
 MAGIC = 0xC0C7
 
@@ -226,6 +231,22 @@ def _read_revealed(b, o):
     return out, o
 
 
+def _pack_epoch(epoch):
+    """u32 mint epoch; 0 encodes "unpinned" (None — the boot verkey of a
+    deployment that never ran a key lifecycle). Real epochs are >= 1
+    (EpochRegistry ids are monotonic from 1)."""
+    e = 0 if epoch is None else int(epoch)
+    if not 0 <= e <= 0xFFFFFFFF:
+        raise ValueError("epoch %r out of u32 range" % (epoch,))
+    return e.to_bytes(4, "big")
+
+
+def _read_epoch(b, o):
+    raw, o = _read_exact(b, o, 4, "epoch")
+    e = int.from_bytes(raw, "big")
+    return (e if e else None), o
+
+
 def _done(b, o, what):
     if o != len(b):
         raise DeserializationError(
@@ -277,8 +298,11 @@ def decode_error(payload):
 
 class Beacon:
     """One replica's periodic health self-report: the engine health-ladder
-    summary (admissible executors / capacity fraction), queue depth, and
-    brownout flag the router's gossip directory routes by."""
+    summary (admissible executors / capacity fraction), queue depth,
+    brownout flag the router's gossip directory routes by, and — since
+    wire v2 — the live key-epoch window (sorted (epoch_id, state) pairs
+    from keylife.EpochRegistry.live_epochs()) so routers know which mint
+    epochs each replica can still serve."""
 
     __slots__ = (
         "replica_id",
@@ -289,6 +313,7 @@ class Beacon:
         "healthy_executors",
         "executors",
         "t",
+        "epochs",
     )
 
     def __init__(
@@ -301,6 +326,7 @@ class Beacon:
         healthy_executors,
         executors,
         t,
+        epochs=(),
     ):
         self.replica_id = replica_id
         self.state = state
@@ -310,6 +336,7 @@ class Beacon:
         self.healthy_executors = healthy_executors
         self.executors = executors
         self.t = t
+        self.epochs = tuple(epochs)
 
     def admissible(self):
         """May the router route NEW sessions here? Mirrors the engine's
@@ -325,6 +352,40 @@ class Beacon:
         return {k: getattr(self, k) for k in self.__slots__}
 
 
+def _pack_epoch_window(epochs):
+    """u16 count + per-entry (u32 epoch, u8 state code); canonical order
+    is ascending epoch id (live_epochs() already sorts)."""
+    entries = list(epochs)
+    if len(entries) > 0xFFFF:
+        raise ValueError("epoch window too long (%d)" % len(entries))
+    out = [len(entries).to_bytes(2, "big")]
+    for epoch, state in entries:
+        code = EPOCH_STATE_CODES.get(state)
+        if code is None:
+            raise ValueError("unknown epoch state %r" % (state,))
+        out.append(int(epoch).to_bytes(4, "big"))
+        out.append(bytes([code]))
+    return b"".join(out)
+
+
+def _read_epoch_window(b, o):
+    if len(b) < o + 2:
+        raise DeserializationError("truncated epoch window")
+    n = int.from_bytes(b[o : o + 2], "big")
+    o += 2
+    out = []
+    for _ in range(n):
+        raw_e, o = _read_exact(b, o, 4, "epoch window")
+        raw_s, o = _read_exact(b, o, 1, "epoch window")
+        state = EPOCH_STATE_OF_CODE.get(raw_s[0])
+        if state is None:
+            raise DeserializationError(
+                "unknown epoch state code %d" % raw_s[0]
+            )
+        out.append((int.from_bytes(raw_e, "big"), state))
+    return tuple(out), o
+
+
 def encode_beacon(beacon):
     return b"".join(
         (
@@ -336,6 +397,7 @@ def encode_beacon(beacon):
             int(beacon.healthy_executors).to_bytes(4, "big"),
             int(beacon.executors).to_bytes(4, "big"),
             _F64.pack(float(beacon.t)),
+            _pack_epoch_window(getattr(beacon, "epochs", ()) or ()),
         )
     )
 
@@ -355,9 +417,11 @@ def decode_beacon(payload):
     executors = int.from_bytes(raw, "big")
     raw, o = _read_exact(payload, o, 8, "beacon")
     (t,) = _F64.unpack(raw)
+    epochs, o = _read_epoch_window(payload, o)
     _done(payload, o, "beacon")
     return Beacon(
-        replica_id, state, capacity, depth, brownout, healthy, executors, t
+        replica_id, state, capacity, depth, brownout, healthy, executors, t,
+        epochs=epochs,
     )
 
 
@@ -418,7 +482,13 @@ class WireCodec:
     # -- verify: (sig, messages) -> bool ------------------------------------
 
     def _enc_req_verify(self, sig, messages):
-        return sig.to_bytes(self.ctx) + _pack_frs(messages)
+        # trailing u32: the credential's mint epoch (0 = unpinned) — the
+        # replica resolves its verkey from the keychain by this id
+        return (
+            sig.to_bytes(self.ctx)
+            + _pack_frs(messages)
+            + _pack_epoch(getattr(sig, "epoch", None))
+        )
 
     def _dec_req_verify(self, b, o):
         from ..signature import Signature
@@ -426,6 +496,9 @@ class WireCodec:
         raw, o = _read_exact(b, o, 2 * self.ctx.sig_nbytes, "Signature")
         sig = Signature.from_bytes(raw, self.ctx)
         msgs, o = _read_frs(b, o)
+        epoch, o = _read_epoch(b, o)
+        if epoch is not None:
+            sig.epoch = epoch
         return (sig, msgs), o
 
     def _enc_resp_verify(self, verdict):
@@ -476,19 +549,26 @@ class WireCodec:
         return (sig_req, msgs, ser.fr_from_bytes(raw)), o
 
     def _enc_resp_mint(self, sig):
-        return sig.to_bytes(self.ctx)
+        # trailing u32: the epoch this credential was minted under (the
+        # keychain-pinned fan-out stamped it in issue._release); clients
+        # carry it into every later verify/show of the credential
+        return sig.to_bytes(self.ctx) + _pack_epoch(
+            getattr(sig, "epoch", None)
+        )
 
     def _dec_resp_mint(self, b, o):
         from ..signature import Signature
 
         raw, o = _read_exact(b, o, 2 * self.ctx.sig_nbytes, "Signature")
-        return Signature.from_bytes(raw, self.ctx), o
+        sig = Signature.from_bytes(raw, self.ctx)
+        epoch, o = _read_epoch(b, o)
+        if epoch is not None:
+            sig.epoch = epoch
+        return sig, o
 
     # -- show_prove: (sig, messages) -> (proof, challenge, revealed) --------
 
-    def _enc_req_show_prove(self, sig, messages):
-        return sig.to_bytes(self.ctx) + _pack_frs(messages)
-
+    _enc_req_show_prove = _enc_req_verify
     _dec_req_show_prove = _dec_req_verify
 
     def _enc_resp_show_prove(self, result):
@@ -509,9 +589,11 @@ class WireCodec:
         revealed, o = _read_revealed(b, o)
         return (proof, challenge, revealed), o
 
-    # -- show_verify: (proof, revealed, challenge) -> bool ------------------
+    # -- show_verify: (proof, revealed, challenge, epoch) -> bool -----------
 
-    def _enc_req_show_verify(self, proof, revealed_msgs, challenge=None):
+    def _enc_req_show_verify(
+        self, proof, revealed_msgs, challenge=None, epoch=None
+    ):
         has = challenge is not None
         return b"".join(
             (
@@ -519,6 +601,9 @@ class WireCodec:
                 _pack_revealed(revealed_msgs),
                 bytes([1 if has else 0]),
                 ser.fr_to_bytes(challenge) if has else b"",
+                # the shown credential's mint epoch (0 = unpinned): a
+                # proof is only sound against the verkey it was built for
+                _pack_epoch(epoch),
             )
         )
 
@@ -533,7 +618,8 @@ class WireCodec:
         if raw[0]:
             raw, o = _read_exact(b, o, 32, "challenge")
             challenge = ser.fr_from_bytes(raw)
-        return (proof, revealed, challenge), o
+        epoch, o = _read_epoch(b, o)
+        return (proof, revealed, challenge, epoch), o
 
     _enc_resp_show_verify = _enc_resp_verify
     _dec_resp_show_verify = _dec_resp_verify

@@ -72,7 +72,12 @@ class VerifyProgram(Program):
         retry_policy,
         fallback_dispatch,
         bisector,
+        keychain=None,
     ):
+        if keychain is not None and mode == "grouped":
+            # grouped mode folds the whole batch into one device bool;
+            # per-epoch verkeys need per-group dispatch, which defeats it
+            raise ValueError("keychain requires per_credential mode")
         self.backend = backend
         self.vk = vk
         self.params = params
@@ -84,6 +89,58 @@ class VerifyProgram(Program):
         self.retry_policy = retry_policy
         self._fallback_dispatch = fallback_dispatch
         self._bisector = bisector
+        #: keylife.EpochRegistry (PR 15): when set, each credential's
+        #: `epoch` attribute resolves the verkey it verifies under (the
+        #: static-operand LRU in tpu/backend.py keys on verkey
+        #: fingerprints, so per-epoch caches coexist); unpinned
+        #: credentials fall back to the boot `vk`
+        self.keychain = keychain
+
+    # -- epoch resolution (PR 15) --------------------------------------------
+
+    def vk_for_epoch(self, epoch):
+        """The verkey a credential minted under `epoch` verifies against.
+        Raises the typed EpochUnknownError/EpochRetiredError — at submit
+        time via the engine's pre-validation, or from inside a dispatch
+        when an epoch retires mid-flight (the batch then fails typed)."""
+        if epoch is None or self.keychain is None:
+            return self.vk
+        return self.keychain.resolve(epoch).vk
+
+    def _dispatch_by_epoch(self, fn, sigs, messages_list):
+        """Partition one coalesced batch by mint epoch and dispatch each
+        group under ITS epoch's verkey (launching every group before
+        finalizing any — same launch/finalize split as the executors),
+        reassembling verdicts by index in the returned finalize thunk.
+        One epoch per steady-state batch in practice (rollovers are
+        rare), so the common case is a single full-width dispatch."""
+        groups = {}
+        for i, s in enumerate(sigs):
+            groups.setdefault(getattr(s, "epoch", None), []).append(i)
+        launched = []
+        for epoch, idxs in sorted(
+            groups.items(), key=lambda kv: (kv[0] is not None, kv[0] or 0)
+        ):
+            vk = self.vk_for_epoch(epoch)
+            launched.append(
+                (
+                    idxs,
+                    fn(
+                        [sigs[i] for i in idxs],
+                        [messages_list[i] for i in idxs],
+                        vk,
+                    ),
+                )
+            )
+
+        def finalize():
+            out = [False] * len(sigs)
+            for idxs, thunk in launched:
+                for i, v in zip(idxs, thunk()):
+                    out[i] = bool(v)
+            return out
+
+        return finalize
 
     # -- engine hooks --------------------------------------------------------
 
@@ -106,14 +163,28 @@ class VerifyProgram(Program):
         # the bare `.dispatch` attribute, not the program registry: the
         # verify program IS every pool executor's primary dispatch (and
         # tests stub `ex.dispatch` directly)
-        return executor.dispatch(sigs, messages_list, self.vk, self.params)
+        if self.keychain is None:
+            return executor.dispatch(
+                sigs, messages_list, self.vk, self.params
+            )
+        return self._dispatch_by_epoch(
+            lambda s, m, vk: executor.dispatch(s, m, vk, self.params),
+            sigs,
+            messages_list,
+        )
 
     def make_fallback(self, sigs, messages_list):
         if self._fallback_dispatch is None:
             return None
-        return lambda: self._fallback_dispatch(
-            sigs, messages_list, self.vk, self.params
-        )()
+        if self.keychain is None:
+            return lambda: self._fallback_dispatch(
+                sigs, messages_list, self.vk, self.params
+            )()
+        return lambda: self._dispatch_by_epoch(
+            lambda s, m, vk: self._fallback_dispatch(s, m, vk, self.params)(),
+            sigs,
+            messages_list,
+        )
 
     def demux(self, requests, result, sigs, messages_list, seq, attempts,
               bspan):

@@ -11,7 +11,7 @@ Cargo.toml:14). Surface matches the call sites cataloged in SURVEY.md §2.2:
 
 import secrets
 
-from .errors import GeneralError
+from .errors import GeneralError, ShareVerificationError
 from .ops.curve import g1 as _g1_ops
 from .ops.fields import R, fr_inv, fr_mul, fr_sub
 from .ops.hashing import hash_to_g1
@@ -132,10 +132,40 @@ class PedersenVSS:
         return f_coeffs[0], g_coeffs[0], comm_coeffs, s_shares, t_shares
 
     @classmethod
-    def verify_share(cls, threshold, share_id, share, comm_coeffs, g, h):
-        """Check g^s h^t == prod_j comm_coeffs[j]^(id^j) — the malicious-dealer
-        detection the protocol's fault tolerance rests on (README.md:52-68,
-        keygen.rs:334-351)."""
+    def deal_zero(cls, threshold, total, g, h):
+        """Deal a sharing of ZERO for proactive refresh (Herzberg et al.):
+        same tuple shape as `deal` but with f(0) = 0 pinned, so adding the
+        resulting shares to an existing sharing rerandomizes every share
+        while leaving the shared secret — and hence the verkey — unchanged.
+        The blinding polynomial stays fully random; recipients additionally
+        check comm_coeffs[0] == h^{b0} against the dealer-published `b0`
+        (the degree-0 commitment opens to zero) before accepting."""
+        if not 0 < threshold <= total:
+            raise GeneralError(
+                "invalid threshold %d for total %d" % (threshold, total)
+            )
+        f_coeffs = poly_random(threshold - 1)
+        f_coeffs[0] = 0
+        g_coeffs = poly_random(threshold - 1)
+        comm_coeffs = {
+            j: cls.ops.add(
+                cls.ops.mul(g, f_coeffs[j]), cls.ops.mul(h, g_coeffs[j])
+            )
+            for j in range(threshold)
+        }
+        s_shares = {i: poly_eval(f_coeffs, i) for i in range(1, total + 1)}
+        t_shares = {i: poly_eval(g_coeffs, i) for i in range(1, total + 1)}
+        return g_coeffs[0], comm_coeffs, s_shares, t_shares
+
+    @classmethod
+    def check_share(
+        cls, threshold, share_id, share, comm_coeffs, g, h,
+        dealer_id=None, round=None,
+    ):
+        """Raising form of `verify_share`: a failed check raises
+        ShareVerificationError carrying the offending `dealer_id` and the
+        lifecycle `round` label, so DKG complaint rounds name the culprit
+        exactly (the corrupt-partial attribution pattern from issue/)."""
         s, t = share
         lhs = cls.ops.add(cls.ops.mul(g, s), cls.ops.mul(h, t))
         bases, exps = [], []
@@ -144,7 +174,30 @@ class PedersenVSS:
             bases.append(comm_coeffs[j])
             exps.append(e)
             e = e * share_id % R
-        return lhs == cls.ops.msm(bases, exps)
+        if lhs != cls.ops.msm(bases, exps):
+            raise ShareVerificationError(
+                "share for participant %d failed verification against "
+                "dealer %s's commitments%s"
+                % (
+                    share_id,
+                    dealer_id if dealer_id is not None else "?",
+                    " in %s round" % round if round else "",
+                ),
+                dealer_id=dealer_id,
+                round=round,
+            )
+
+    @classmethod
+    def verify_share(cls, threshold, share_id, share, comm_coeffs, g, h):
+        """Check g^s h^t == prod_j comm_coeffs[j]^(id^j) — the malicious-dealer
+        detection the protocol's fault tolerance rests on (README.md:52-68,
+        keygen.rs:334-351). Boolean convenience over `check_share` (which
+        raises with dealer attribution and is what the online paths use)."""
+        try:
+            cls.check_share(threshold, share_id, share, comm_coeffs, g, h)
+        except ShareVerificationError:
+            return False
+        return True
 
 
 # --- Pedersen decentralized (dealerless) VSS --------------------------------
@@ -178,20 +231,26 @@ class PedersenDVSSParticipant:
         self.final_comm_coeffs = None
 
     def received_share(self, from_id, comm_coeffs, share, threshold, total, g, h):
-        """Verify and store a share of `from_id`'s secret, evaluated at our id."""
+        """Verify and store a share of `from_id`'s secret, evaluated at our
+        id. Every reject path raises ShareVerificationError naming the
+        dealer, so DVSS/DKG complaint rounds attribute exactly."""
         if from_id == self.id:
-            raise GeneralError("participant %d received its own share" % self.id)
+            raise ShareVerificationError(
+                "participant %d received its own share" % self.id,
+                dealer_id=from_id,
+                round="dvss",
+            )
         if from_id in self._received:
-            raise GeneralError(
-                "participant %d already has a share from %d" % (self.id, from_id)
+            raise ShareVerificationError(
+                "participant %d already has a share from %d"
+                % (self.id, from_id),
+                dealer_id=from_id,
+                round="dvss",
             )
-        if not PedersenVSS.verify_share(
-            threshold, self.id, share, comm_coeffs, g, h
-        ):
-            raise GeneralError(
-                "share from participant %d failed verification at %d"
-                % (from_id, self.id)
-            )
+        PedersenVSS.check_share(
+            threshold, self.id, share, comm_coeffs, g, h,
+            dealer_id=from_id, round="dvss",
+        )
         self._received[from_id] = share
         self._received_comms[from_id] = comm_coeffs
 

@@ -1,0 +1,216 @@
+"""Key-lifecycle acceptance smoke (the PR-15 rollover-under-traffic check).
+
+    JAX_PLATFORMS=cpu python probes/probe_epoch.py
+
+Runs a REAL 5-authority fleet over a loopback TCP socket: the authorities
+are born from an ONLINE DKG (no dealer, no in-process master secret), the
+engine serves full credential sessions through a net.Replica wire loop,
+and the key lifecycle rolls over underneath live traffic:
+
+  - DKG bootstraps epoch 1 with a deliberately corrupt dealer, who is
+    complained against BY NAME and excluded from QUAL — and still
+    receives signing shares;
+  - concurrent client threads run prepare -> mint -> verify ->
+    show_prove -> show_verify sessions nonstop while the lifecycle takes
+    ONE proactive refresh (same verkey bit-for-bit, every share changed)
+    and ONE t/n reshare (3-of-5 -> 2-of-5, new epoch) — zero dangling
+    futures, zero terminal errors across the whole run;
+  - every pre-rollover credential verifies post-rollover under its MINT
+    epoch, over the wire, while new mints land on the new epoch;
+  - the replica's health beacon advertises the live epoch window through
+    each transition (1 active -> 1 retiring + 2 active).
+
+Prints a one-line JSON report for the CI log. Everything runs on the
+CPU in well under a minute.
+"""
+
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from coconut_tpu import metrics, net
+from coconut_tpu.backend import get_backend
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.engine import ProtocolEngine
+from coconut_tpu.keylife import ACTIVE, RETIRING, KeyLifecycleManager
+from coconut_tpu.params import Params
+from coconut_tpu.sss import rand_fr
+
+THRESHOLD, TOTAL = 3, 5
+MSGS = 3
+TRAFFIC_THREADS = 3
+
+
+def _corrupt_dealer(d, r, dim, share):
+    """Dealer 2 hands recipient 4 a share off the committed polynomial."""
+    if (d, r, dim) == (2, 4, 0):
+        return (share[0] + 1, share[1])
+    return None
+
+
+def _run_session(client, params, creds, timeout=120.0):
+    """One full credential session; records the minted credential."""
+    msgs = [rand_fr() for _ in range(MSGS)]
+    esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+    req, _ = client.submit_prepare(msgs, epk).result(timeout)
+    cred = client.submit_mint(req, msgs, esk).result(timeout)
+    assert client.submit_verify(cred, msgs).result(timeout) is True
+    proof, chal, rev = client.submit_show_prove(cred, msgs).result(timeout)
+    ok = client.submit_show_verify(
+        proof, rev, chal, epoch=cred.epoch
+    ).result(timeout)
+    assert ok is True, "show_verify verdict False mid-traffic"
+    creds.append((cred, msgs))
+
+
+def main():
+    metrics.reset()
+    params = Params.new(MSGS, b"probe-epoch")
+    codec = net.WireCodec(params)
+
+    # -- online DKG: corrupt dealer named + excluded, no master secret ---
+    mgr = KeyLifecycleManager(params, label=b"probe-epoch", window=3)
+    ks1 = mgr.bootstrap(THRESHOLD, TOTAL, tamper=_corrupt_dealer)
+    assert mgr.last_round.complaints == {2: (4,)}, (
+        "complaints misattributed: %r" % (mgr.last_round.complaints,)
+    )
+    assert ks1.excluded == (2,)
+    assert sorted(s.id for s in ks1.signers) == [1, 2, 3, 4, 5]
+
+    eng = ProtocolEngine(
+        [ks1.signer(i) for i in range(1, TOTAL + 1)],
+        params,
+        THRESHOLD,
+        count_hidden=1,
+        revealed_msg_indices=[1, 2],
+        vk=ks1.vk,
+        backend=get_backend("python"),
+        devices=1,
+        max_batch=4,
+        max_wait_ms=5.0,
+        keychain=mgr.registry,
+    ).start()
+    mgr.attach(eng)
+    replica = net.Replica(eng, codec, replica_id="r0")
+    replica.serve()
+
+    def connect(session):
+        return net.GatewayClient(
+            net.SocketTransport(replica.address), codec, session=session
+        )
+
+    report = {"authorities": TOTAL, "threshold_before": THRESHOLD}
+    clients = []
+    try:
+        beacon_client = connect("beacon")
+        clients.append(beacon_client)
+        epochs = beacon_client.poll_beacon(timeout=5.0).epochs
+        assert epochs == ((1, ACTIVE),), (
+            "beacon window wrong at bootstrap: %r" % (epochs,)
+        )
+
+        # -- nonstop traffic while the lifecycle rolls over --------------
+        creds, errors = [], []
+        stop = threading.Event()
+
+        def pump(tid):
+            client = connect("pump-%d" % tid)
+            clients.append(client)
+            try:
+                while not stop.is_set():
+                    _run_session(client, params, creds)
+            except Exception as e:  # terminal error: the probe fails
+                errors.append("pump-%d: %r" % (tid, e))
+
+        pumps = [
+            threading.Thread(target=pump, args=(t,), daemon=True)
+            for t in range(TRAFFIC_THREADS)
+        ]
+        for p in pumps:
+            p.start()
+        while len(creds) < 4 and not errors:  # pre-rollover corpus
+            stop.wait(0.05)
+        pre = list(creds)
+
+        before = {
+            s.id: (s.sigkey.x, tuple(s.sigkey.y)) for s in ks1.signers
+        }
+        ks1r = mgr.refresh()  # under traffic
+        assert ks1r.vk.to_bytes(params.ctx) == ks1.vk.to_bytes(params.ctx)
+        assert all(
+            before[s.id] != (s.sigkey.x, tuple(s.sigkey.y))
+            for s in ks1r.signers
+        ), "refresh left a share unchanged"
+        while len(creds) < len(pre) + 2 and not errors:
+            stop.wait(0.05)
+
+        ks2 = mgr.reshare(threshold=2, total=TOTAL)  # under traffic
+        assert ks2.epoch == 2
+        while len(creds) < len(pre) + 4 and not errors:
+            stop.wait(0.05)
+        stop.set()
+        for p in pumps:
+            p.join(120.0)
+            assert not p.is_alive(), "traffic pump hung (dangling futures)"
+        assert not errors, "terminal errors mid-rollover: %s" % errors
+
+        epochs = beacon_client.poll_beacon(timeout=5.0).epochs
+        assert epochs == ((1, RETIRING), (2, ACTIVE)), (
+            "beacon window wrong after reshare: %r" % (epochs,)
+        )
+
+        # -- every pre-rollover credential verifies under its mint epoch -
+        check = connect("post-check")
+        clients.append(check)
+        for cred, msgs in pre:
+            assert cred.epoch == 1, "pre-rollover cred stamped %d" % (
+                cred.epoch,
+            )
+            assert check.submit_verify(cred, msgs).result(120.0) is True, (
+                "pre-rollover credential failed post-rollover"
+            )
+        post_epochs = sorted({c.epoch for c, _ in creds[len(pre):]})
+        fresh = []
+        _run_session(check, params, fresh)
+        assert fresh[0][0].epoch == 2, "new mints not on the new epoch"
+
+        report.update(
+            {
+                "threshold_after": 2,
+                "sessions_completed": len(creds) + 1,
+                "pre_rollover_verified": len(pre),
+                "mid_rollover_epochs": post_epochs,
+                "corrupt_dealer_excluded": list(ks1.excluded),
+                "refreshes": metrics.get_count("keylife_refreshes"),
+                "reshares": metrics.get_count("keylife_reshares"),
+                "gateway_errors": metrics.get_count("gateway_errors"),
+                "live_epochs": metrics.get_gauge("keylife_live_epochs"),
+            }
+        )
+    finally:
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+        replica.close()
+        assert eng.drain(timeout=60.0), "engine drain timed out"
+
+    assert report["gateway_errors"] == 0, "engine-side terminal errors"
+    for e in (1, 2):
+        assert mgr.registry.pin_count(e) == 0, "leaked epoch pin"
+
+    print(json.dumps(report, sort_keys=True))
+    print(
+        "epoch probe: ok (%d sessions through 1 refresh + 1 reshare, "
+        "%d pre-rollover creds verified post-rollover, dealer 2 excluded)"
+        % (report["sessions_completed"], report["pre_rollover_verified"])
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

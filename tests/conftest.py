@@ -102,6 +102,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "keylife: dealerless key-lifecycle suite (online DKG with "
+        "complaint attribution, proactive refresh, t/n reshare, epoch "
+        "registry window/pinning, epoch-keyed wire + cache behavior, "
+        "fake-clock rollover chaos drill), also run explicitly by "
+        "ci.sh's keylife lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

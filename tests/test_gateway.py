@@ -38,12 +38,16 @@ from coconut_tpu.engine import ProtocolEngine
 from coconut_tpu.errors import (
     WIRE_ERROR_CODES,
     DeserializationError,
+    DkgAbortedError,
+    EpochRetiredError,
+    EpochUnknownError,
     GeneralError,
     QuorumUnreachableError,
     ServiceBrownoutError,
     ServiceClosedError,
     ServiceOverloadedError,
     ServiceRetryableError,
+    ShareVerificationError,
     TenantAuthError,
     TenantQuotaError,
     TenantRateLimitError,
@@ -140,7 +144,8 @@ def session_objects(world, engine):
 def test_frame_header_golden():
     """The 12-byte header layout is a compatibility promise — pinned."""
     frame = wire.encode_frame(0x01, b"abc", seq=7)
-    assert frame.hex() == "c0c701010000000700000003616263"
+    # version byte is 02 since PR 15 (epochs on the wire)
+    assert frame.hex() == "c0c702010000000700000003616263"
     msg_type, seq, payload = wire.decode_frame(frame)
     assert (msg_type, seq, payload) == (0x01, 7, b"abc")
 
@@ -170,6 +175,7 @@ def test_beacon_golden():
         "00027232000862726f776e6f75743fe000000000000000000011"
         "01000000020000000440288000"
         "00000000"
+        "0000"  # v2: empty epoch window (no key lifecycle)
     )
     d = wire.decode_beacon(wire.encode_beacon(b))
     assert d.as_dict() == b.as_dict()
@@ -177,6 +183,28 @@ def test_beacon_golden():
     assert not wire.Beacon(
         "r2", "quarantined", 0.0, 0, False, 0, 4, 0.0
     ).admissible()
+
+
+def test_beacon_epoch_window_golden():
+    """v2 beacons advertise the live key-epoch window: u16 count +
+    (u32 epoch, u8 state) entries, ascending epoch order — pinned."""
+    b = wire.Beacon(
+        "r2", "healthy", 1.0, 0, False, 1, 1, 0.0,
+        epochs=((1, "retiring"), (2, "active")),
+    )
+    enc = wire.encode_beacon(b)
+    assert enc.hex().endswith(
+        "0002"  # two live epochs
+        "0000000102"  # epoch 1: retiring (code 2)
+        "0000000201"  # epoch 2: active (code 1)
+    )
+    d = wire.decode_beacon(enc)
+    assert d.epochs == ((1, "retiring"), (2, "active"))
+    assert d.as_dict() == b.as_dict()
+    bad = bytearray(enc)
+    bad[-1] = 0xEE  # unknown epoch-state code must refuse, not misparse
+    with pytest.raises(DeserializationError, match="epoch state"):
+        wire.decode_beacon(bytes(bad))
 
 
 def test_verify_request_golden_digest():
@@ -189,9 +217,10 @@ def test_verify_request_golden_digest():
         "verify", (sig, [1, 2, 3]), lane="interactive",
         api_key="k", session="s",
     )
-    assert len(payload) == 297
+    # +4 over v1: the trailing u32 mint epoch (0 here — unpinned sig)
+    assert len(payload) == 301
     assert hashlib.sha256(payload).hexdigest() == (
-        "5bf13a188ede2818f3916a6ba4e5ecb3320a22c1dae41aff9592878e086bc73e"
+        "c1f36595386d398c6b73b84d97c5c78a1a7a1a4cb0ba68b26adfc1e7c4e30ba5"
     )
     assert codec.encode_response("verify", True).hex() == "01"
     assert codec.encode_response("verify", False).hex() == "00"
@@ -356,6 +385,11 @@ def test_error_codes_stable_and_unique():
         TenantAuthError: "tenant_auth",
         TenantQuotaError: "tenant_quota",
         TenantRateLimitError: "tenant_rate_limited",
+        # PR 15: key-lifecycle refusals travel the same envelope
+        ShareVerificationError: "share_rejected",
+        DkgAbortedError: "dkg_aborted",
+        EpochUnknownError: "epoch_unknown",
+        EpochRetiredError: "epoch_retired",
     }
     for cls, code in expected.items():
         assert cls.code == code
@@ -392,6 +426,15 @@ def test_error_from_wire_reconstructs_classes():
         TransientBackendError("hiccup"),
         DeserializationError("garbage"),
         GeneralError("boom"),
+        # PR 15: key-lifecycle refusals
+        ShareVerificationError(
+            "dealer 2 share for recipient 4 failed Pedersen check",
+            dealer_id=2, round="dkg",
+        ),
+        DkgAbortedError(3, 2, excluded=(1,), program="mint",
+                        retry_after_s=0.5),
+        EpochUnknownError(9, live=(1, 2)),
+        EpochRetiredError(1, live=(2, 3)),
     ]
     for orig in originals:
         decoded = wire.decode_error(wire.encode_error(orig))

@@ -1,0 +1,618 @@
+"""Dealerless key-lifecycle suite (PR 15).
+
+Covers the PR-15 contract surface:
+
+  - SSS REJECT PATHS: every bad-share path raises the typed
+    ShareVerificationError naming the dealer (tampered share, wrong
+    recipient id, own share echoed back, duplicate dealer) and bad
+    thresholds refuse up front;
+  - ONLINE DKG: complaints name the corrupt dealer EXACTLY, unreachable
+    quorums abort with the typed retryable DkgAbortedError, and no code
+    path materializes the master secret (enforced two ways: the
+    DkgResult shape is pinned, and the in-process aggregation entry
+    points are booby-trapped for the whole manager surface);
+  - PROACTIVE REFRESH: the verkey stays bit-identical while EVERY share
+    changes; a secret-shifting dealer is complained against and
+    excluded without moving the verkey;
+  - EPOCH REGISTRY: monotonic ids, two-phase PENDING->ACTIVE handoff,
+    window-pressure retirement (pins defer it), typed
+    EpochUnknownError/EpochRetiredError carrying the live set;
+  - EPOCH-KEYED STATIC-OPERAND CACHE: two epochs' verkey fingerprints
+    coexist in the 32-entry LRU without evict-thrash;
+  - THE ROLLOVER CHAOS DRILL: a 5-authority engine behind the RPC
+    gateway performs DKG (with a corrupt dealer named + excluded),
+    serves mints, takes one proactive refresh and one t/n reshare under
+    in-flight traffic, and every pre-rollover credential verifies
+    post-rollover under its mint epoch — zero dangling futures, zero
+    engine-side terminal errors, wrong-epoch verification rejects, and
+    retirement out of the window refuses typed through the envelope.
+"""
+
+from types import SimpleNamespace
+
+import pytest
+
+from coconut_tpu import metrics
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.engine import ProtocolEngine
+from coconut_tpu.errors import (
+    DkgAbortedError,
+    EpochRetiredError,
+    EpochUnknownError,
+    GeneralError,
+    ServiceRetryableError,
+    ShareVerificationError,
+)
+from coconut_tpu.keylife import (
+    ACTIVE,
+    EPOCH_STATE_CODES,
+    EPOCH_STATE_OF_CODE,
+    DkgResult,
+    EpochRegistry,
+    KeyLifecycleManager,
+    KeySet,
+    PENDING,
+    RETIRED,
+    RETIRING,
+    run_dkg,
+    run_refresh,
+)
+from coconut_tpu.net import gossip, rpc, wire
+from coconut_tpu.params import Params
+from coconut_tpu.sss import (
+    PedersenDVSSParticipant,
+    PedersenVSS,
+    get_shared_secret,
+    rand_fr,
+    reconstruct_secret,
+)
+
+pytestmark = pytest.mark.keylife
+
+MSGS = 2
+HIDDEN = 1
+REVEALED = [1]
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Params.new(MSGS, b"test-keylife")
+
+
+@pytest.fixture(scope="module")
+def gens():
+    return PedersenVSS.gens(b"test-keylife")
+
+
+def _stub_keyset(epoch, gen=0):
+    """Registry-only KeySet: the registry never inspects key material."""
+    return KeySet(epoch, gen, 2, [], vk=None)
+
+
+# --- satellite: sss reject paths --------------------------------------------
+
+
+def test_check_share_rejects_tampered_share(gens):
+    g, h = gens
+    _, _, comm, s_shares, t_shares = PedersenVSS.deal(2, 3, g, h)
+    good = (s_shares[2], t_shares[2])
+    PedersenVSS.check_share(2, 2, good, comm, g, h)  # honest passes
+    with pytest.raises(ShareVerificationError) as ei:
+        PedersenVSS.check_share(
+            2, 2, ((good[0] + 1) % (1 << 255), good[1]), comm, g, h,
+            dealer_id=7, round="dkg",
+        )
+    assert ei.value.dealer_id == 7
+    assert ei.value.round == "dkg"
+    assert ei.value.code == "share_rejected"
+    assert not PedersenVSS.verify_share(
+        2, 2, (good[0] + 1, good[1]), comm, g, h
+    )
+
+
+def test_check_share_rejects_tampered_commitment(gens):
+    g, h = gens
+    _, _, comm, s_shares, t_shares = PedersenVSS.deal(2, 3, g, h)
+    bad = dict(comm)
+    bad[1] = PedersenVSS.ops.add(bad[1], g)  # dealer lied about a coeff
+    with pytest.raises(ShareVerificationError):
+        PedersenVSS.check_share(
+            2, 1, (s_shares[1], t_shares[1]), bad, g, h, dealer_id=1
+        )
+
+
+def test_check_share_rejects_wrong_recipient_id(gens):
+    g, h = gens
+    _, _, comm, s_shares, t_shares = PedersenVSS.deal(2, 3, g, h)
+    # share dealt for id 2, presented as id 3: never verifies
+    with pytest.raises(ShareVerificationError):
+        PedersenVSS.check_share(
+            2, 3, (s_shares[2], t_shares[2]), comm, g, h, dealer_id=1
+        )
+
+
+def test_deal_rejects_bad_threshold(gens):
+    g, h = gens
+    for t, n in ((4, 3), (0, 3)):
+        with pytest.raises(GeneralError):
+            PedersenVSS.deal(t, n, g, h)
+        with pytest.raises(GeneralError):
+            PedersenVSS.deal_zero(t, n, g, h)
+        with pytest.raises(GeneralError):
+            get_shared_secret(t, n)
+
+
+def test_dvss_rejects_own_share_and_duplicate_dealer(gens):
+    g, h = gens
+    p = PedersenDVSSParticipant(1, 2, 3, g, h)
+    dealer = PedersenDVSSParticipant(2, 2, 3, g, h)
+    share = (dealer.s_shares[1], dealer.t_shares[1])
+    with pytest.raises(ShareVerificationError) as ei:
+        p.received_share(1, p.comm_coeffs, (p.s_shares[1], p.t_shares[1]),
+                         2, 3, g, h)
+    assert ei.value.dealer_id == 1
+    p.received_share(2, dealer.comm_coeffs, share, 2, 3, g, h)
+    with pytest.raises(ShareVerificationError) as ei:
+        p.received_share(2, dealer.comm_coeffs, share, 2, 3, g, h)
+    assert ei.value.dealer_id == 2  # the duplicate dealer is named
+
+
+def test_deal_zero_shares_a_verifiable_zero(gens):
+    g, h = gens
+    blind0, comm, s_shares, t_shares = PedersenVSS.deal_zero(3, 5, g, h)
+    # the published degree-0 blinding opens the zero commitment
+    assert comm[0] == PedersenVSS.ops.mul(h, blind0)
+    # the shared secret really is zero
+    assert reconstruct_secret(3, s_shares) == 0
+    # and each share still Pedersen-verifies
+    for i in range(1, 6):
+        PedersenVSS.check_share(3, i, (s_shares[i], t_shares[i]), comm, g, h)
+
+
+# --- tentpole: online DKG ---------------------------------------------------
+
+
+def _tamper_one(dealer, recipient, dim=0):
+    def tamper(d, r, dm, share):
+        if (d, r, dm) == (dealer, recipient, dim):
+            return ((share[0] + 1), share[1])
+        return None
+
+    return tamper
+
+
+def test_dkg_complaints_name_corrupt_dealer_exactly(params, gens):
+    g, h = gens
+    result = run_dkg(3, 5, params, g, h, tamper=_tamper_one(2, 4))
+    assert result.complaints == {2: (4,)}  # exactly dealer 2, by rec 4
+    assert result.excluded == (2,)
+    assert result.qual == (1, 3, 4, 5)
+    # the excluded DEALER still received key shares (it can sign later)
+    assert sorted(s.id for s in result.signers) == [1, 2, 3, 4, 5]
+
+
+def test_dkg_aborts_typed_when_quorum_unreachable(params, gens):
+    g, h = gens
+    with pytest.raises(DkgAbortedError) as ei:
+        run_dkg(4, 5, params, g, h, unreachable={1, 2})
+    err = ei.value
+    assert isinstance(err, ServiceRetryableError)  # retriable by type
+    assert err.code == "dkg_aborted"
+    assert (err.needed, err.qualified) == (4, 3)
+    assert err.excluded == (1, 2)
+
+
+def test_dkg_result_carries_no_master_secret(params, gens):
+    """The acceptance invariant: DkgResult holds per-signer shares and
+    the dealer audit trail — never the reconstructed master secret."""
+    g, h = gens
+    result = run_dkg(2, 3, params, g, h)
+    assert DkgResult._fields == (
+        "signers", "qual", "excluded", "complaints", "threshold", "total",
+    )
+    # reconstruct the master secrets independently (test-only!) and
+    # assert they appear nowhere in the round's output
+    master = {reconstruct_secret(2, {s.id: s.sigkey.x for s in result.signers})}
+    for j in range(MSGS):
+        master.add(
+            reconstruct_secret(
+                2, {s.id: s.sigkey.y[j] for s in result.signers}
+            )
+        )
+    for s in result.signers:
+        assert s.sigkey.x not in master
+        assert master.isdisjoint(s.sigkey.y)
+    assert master.isdisjoint(result.qual)
+    assert master.isdisjoint(result.excluded)
+    assert master.isdisjoint({result.threshold, result.total})
+
+
+def test_online_lifecycle_never_aggregates_in_process(params, monkeypatch):
+    """Booby-trap every in-process master-secret aggregation entry point
+    (sss.reconstruct_secret / sss.get_shared_secret / keygen's dealer and
+    DVSS drivers): the whole manager surface — bootstrap, refresh,
+    reshare — must complete without touching any of them. Only the test
+    alias setup_signers_for_test may aggregate in-process."""
+    import coconut_tpu.keygen as keygen_mod
+    import coconut_tpu.sss as sss_mod
+
+    def boom(*a, **k):
+        raise AssertionError(
+            "master-secret aggregation on the online DKG path"
+        )
+
+    for mod, name in (
+        (sss_mod, "reconstruct_secret"),
+        (sss_mod, "get_shared_secret"),
+        (keygen_mod, "get_shared_secret"),
+        (keygen_mod, "dvss_keygen"),
+        (keygen_mod, "setup_signers_for_test"),
+        (keygen_mod, "trusted_party_SSS_keygen"),
+    ):
+        monkeypatch.setattr(mod, name, boom)
+    mgr = KeyLifecycleManager(params, label=b"keylife-noagg")
+    ks1 = mgr.bootstrap(2, 3)
+    ks1r = mgr.refresh()
+    ks2 = mgr.reshare()
+    assert (ks1.epoch, ks1r.gen, ks2.epoch) == (1, 1, 2)
+
+
+# --- tentpole: proactive refresh --------------------------------------------
+
+
+def _share_map(signers):
+    return {s.id: (s.sigkey.x, tuple(s.sigkey.y)) for s in signers}
+
+
+def test_refresh_same_verkey_all_shares_change(params):
+    mgr = KeyLifecycleManager(params, label=b"keylife-refresh")
+    ks1 = mgr.bootstrap(3, 5)
+    before = _share_map(ks1.signers)
+    ks1r = mgr.refresh()
+    after = _share_map(ks1r.signers)
+    ctx = params.ctx
+    assert ks1r.vk.to_bytes(ctx) == ks1.vk.to_bytes(ctx)  # bit-identical
+    assert (ks1r.epoch, ks1r.gen) == (ks1.epoch, ks1.gen + 1)
+    for i in before:
+        assert before[i][0] != after[i][0]  # every x share changed
+        for y_old, y_new in zip(before[i][1], after[i][1]):
+            assert y_old != y_new  # every y share changed
+    # the registry now serves the new gen under the SAME epoch
+    assert mgr.registry.resolve(ks1.epoch).gen == ks1.gen + 1
+
+
+def test_refresh_excludes_secret_shifting_dealer(params):
+    """A dealer whose refresh share fails verification is complained
+    against and excluded — and the round STILL leaves the verkey
+    bit-identical (the shift never lands)."""
+    mgr = KeyLifecycleManager(params, label=b"keylife-refresh-bad")
+    ks1 = mgr.bootstrap(3, 5)
+    ks1r = mgr.refresh(tamper=_tamper_one(3, 1))
+    assert mgr.last_round.complaints == {3: (1,)}
+    assert 3 not in mgr.last_round.qual
+    assert ks1r.vk.to_bytes(params.ctx) == ks1.vk.to_bytes(params.ctx)
+
+
+def test_refresh_aborts_when_quorum_unreachable(params, gens):
+    g, h = gens
+    result = run_dkg(3, 4, params, g, h)
+    with pytest.raises(DkgAbortedError):
+        run_refresh(result.signers, 3, params, g, h, unreachable={1, 2})
+
+
+# --- epoch registry ---------------------------------------------------------
+
+
+def test_registry_two_phase_and_monotonic_ids():
+    reg = EpochRegistry(window=3)
+    assert reg.next_epoch() == 1
+    ks = _stub_keyset(1)
+    reg.register(ks)
+    assert reg.state(1) == PENDING
+    with pytest.raises(EpochUnknownError):
+        reg.resolve(1)  # registered but NOT yet activated
+    reg.activate(1)
+    assert reg.state(1) == ACTIVE
+    assert reg.resolve(1) is ks
+    with pytest.raises(GeneralError, match="monotonic"):
+        reg.register(_stub_keyset(1))
+    with pytest.raises(GeneralError, match="not pending"):
+        reg.activate(1)
+    with pytest.raises(GeneralError, match="unknown"):
+        reg.activate(9)
+
+
+def test_registry_window_pressure_retires_oldest():
+    metrics.reset()
+    reg = EpochRegistry(window=2)
+    for e in (1, 2, 3, 4):
+        reg.register(_stub_keyset(e))
+        reg.activate(e)
+    assert reg.live_epochs() == [(3, RETIRING), (4, ACTIVE)]
+    assert reg.state(1) == RETIRED
+    assert reg.state(2) == RETIRED
+    with pytest.raises(EpochRetiredError) as ei:
+        reg.resolve(1)
+    assert ei.value.epoch == 1
+    assert ei.value.live == (3, 4)  # carried for client re-resolution
+    with pytest.raises(EpochUnknownError) as ei:
+        reg.resolve(99)
+    assert ei.value.live == (3, 4)
+    assert metrics.get_count("keylife_retirements") == 2
+    assert metrics.get_count("keylife_epoch_retired") == 1
+    assert metrics.get_count("keylife_epoch_unknown") == 1
+
+
+def test_registry_pins_defer_retirement():
+    reg = EpochRegistry(window=1)
+    reg.register(_stub_keyset(1))
+    reg.activate(1)
+    pinned = reg.pin_active()
+    reg.register(_stub_keyset(2))
+    reg.activate(2)
+    # over the window, but epoch 1 has an open fan-out: retirement waits
+    assert reg.state(1) == RETIRING
+    assert reg.resolve(1) is pinned
+    assert reg.pin_count(1) == 1
+    reg.unpin(pinned)
+    assert reg.state(1) == RETIRED
+    with pytest.raises(EpochRetiredError):
+        reg.resolve(1)
+
+
+def test_registry_refresh_gen_pins_coexist():
+    reg = EpochRegistry(window=3)
+    ks_g0 = _stub_keyset(1, gen=0)
+    reg.register(ks_g0)
+    reg.activate(1)
+    pinned_old = reg.pin_active()
+    assert pinned_old is ks_g0
+    reg.install_gen(_stub_keyset(1, gen=1))
+    pinned_new = reg.pin_active()
+    assert pinned_new.gen == 1  # new fan-outs pin the refreshed set
+    assert reg.pin_count(1) == 2  # both gens' fan-outs in flight
+    with pytest.raises(GeneralError, match="gen"):
+        reg.install_gen(_stub_keyset(1, gen=5))  # gens are sequential
+    reg.unpin(pinned_old)
+    reg.unpin(pinned_new)
+    assert reg.pin_count(1) == 0
+
+
+def test_epoch_state_wire_codes_pinned():
+    assert EPOCH_STATE_CODES == {
+        PENDING: 0, ACTIVE: 1, RETIRING: 2, RETIRED: 3,
+    }
+    assert EPOCH_STATE_OF_CODE == {
+        0: PENDING, 1: ACTIVE, 2: RETIRING, 3: RETIRED,
+    }
+
+
+def test_manager_attach_replays_live_epochs(params):
+    mgr = KeyLifecycleManager(params, label=b"keylife-attach")
+    ks1 = mgr.bootstrap(2, 3)
+    ks2 = mgr.reshare()
+    installed = []
+    mgr.attach(SimpleNamespace(install_keyset=installed.append))
+    # late-attached services immediately learn every live epoch
+    assert sorted(k.epoch for k in installed) == [ks1.epoch, ks2.epoch]
+
+
+# --- satellite: epoch-keyed static-operand cache ----------------------------
+
+
+def test_epoch_verkey_fingerprints_coexist_in_static_cache(params):
+    """Across a rollover BOTH epochs' verkeys are in play (old creds
+    verify under the retiring epoch while new mints pin the new one).
+    Their static-operand entries must coexist in the 32-entry LRU —
+    alternating epochs is all hits after first build, no evict-thrash."""
+    from coconut_tpu.tpu import backend as tbe
+
+    mgr = KeyLifecycleManager(params, label=b"keylife-cache")
+    ks1 = mgr.bootstrap(2, 3)
+    ks2 = mgr.reshare()
+    assert ks1.vk.to_bytes(params.ctx) != ks2.vk.to_bytes(params.ctx)
+    fp1 = tbe._static_fingerprint(ks1.vk, params)
+    fp2 = tbe._static_fingerprint(ks2.vk, params)
+    assert fp1 != fp2  # distinct epochs -> distinct cache keys
+
+    saved = dict(tbe._STATIC_CACHE)
+    tbe._STATIC_CACHE.clear()
+    metrics.reset()
+    try:
+        builds = []
+
+        def lookup(ks):
+            return tbe._static_operands(
+                "verify", ks.vk, params, None,
+                lambda: builds.append(ks.epoch) or ("tables", ks.epoch),
+            )
+
+        assert lookup(ks1) == ("tables", ks1.epoch)
+        assert lookup(ks2) == ("tables", ks2.epoch)
+        assert builds == [ks1.epoch, ks2.epoch]  # one build each
+        for _ in range(8):  # alternate: pure hits, no rebuilds
+            assert lookup(ks1)[1] == ks1.epoch
+            assert lookup(ks2)[1] == ks2.epoch
+        assert builds == [ks1.epoch, ks2.epoch]
+        assert metrics.get_count("encode_cache_misses") == 2
+        assert metrics.get_count("encode_cache_hits") == 16
+        # crowding the LRU with 30 other entries keeps both epochs
+        # resident (32-entry capacity; recency protects the hot pair)
+        for i in range(30):
+            tbe._static_operands(
+                "verify", ks1.vk, params, ("pad", i), lambda: object()
+            )
+            lookup(ks1)
+            lookup(ks2)
+        assert builds == [ks1.epoch, ks2.epoch]  # still never rebuilt
+    finally:
+        tbe._STATIC_CACHE.clear()
+        tbe._STATIC_CACHE.update(saved)
+
+
+# --- the epoch-rollover chaos drill -----------------------------------------
+
+
+def test_epoch_rollover_chaos_drill(params):
+    """The PR's acceptance drill, deterministic over loopback RPC: a
+    5-authority engine bootstraps via DKG (corrupt dealer named and
+    excluded), serves full sessions, takes one proactive refresh and one
+    t/n reshare with mints in flight, and every pre-rollover credential
+    verifies post-rollover under its mint epoch. Zero dangling futures,
+    zero engine-side terminal errors; wrong-epoch verification rejects;
+    window-pressure retirement refuses typed through the envelope."""
+    metrics.reset()
+    mgr = KeyLifecycleManager(params, label=b"keylife-drill", window=3)
+
+    # 1) DKG with a corrupt dealer: named exactly, excluded, round lands
+    ks1 = mgr.bootstrap(3, 5, tamper=_tamper_one(2, 4))
+    assert mgr.last_round.complaints == {2: (4,)}
+    assert ks1.excluded == (2,)
+    eng = ProtocolEngine(
+        [ks1.signer(i) for i in range(1, 6)],
+        params,
+        3,
+        count_hidden=HIDDEN,
+        revealed_msg_indices=REVEALED,
+        vk=ks1.vk,
+        backend="python",
+        devices=1,
+        max_batch=4,
+        max_wait_ms=5.0,
+        keychain=mgr.registry,
+    ).start()
+    mgr.attach(eng)
+    codec = wire.WireCodec(params)
+    replica = rpc.Replica(eng, codec, replica_id="r0")
+    client = rpc.GatewayClient(rpc.LoopbackTransport(replica), codec)
+    directory = gossip.HealthDirectory(["r0"])
+    loop = gossip.GossipLoop(
+        directory,
+        {"r0": lambda: client.poll_beacon(timeout=5.0)},
+        clock=FakeClock(),
+    )
+    loop.step()
+    assert directory.epochs("r0") == ((1, ACTIVE),)
+
+    settled = []
+
+    def mint_one():
+        msgs = [rand_fr() for _ in range(MSGS)]
+        esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+        sig_req, _ = client.submit_prepare(msgs, epk).result(120.0)
+        cred = client.submit_mint(sig_req, msgs, esk).result(120.0)
+        settled.append(cred)
+        return cred, msgs
+
+    def full_show(cred, msgs):
+        proof, chal, rev = client.submit_show_prove(cred, msgs).result(
+            120.0
+        )
+        # explicit challenge AND the stranger-verifier re-hash path
+        assert client.submit_show_verify(
+            proof, rev, chal, epoch=cred.epoch
+        ).result(120.0) is True
+        assert client.submit_show_verify(
+            proof, rev, None, epoch=cred.epoch
+        ).result(120.0) is True
+
+    pre = [mint_one() for _ in range(3)]
+    assert all(c.epoch == 1 for c, _ in pre)  # stamped over the wire
+    full_show(*pre[0])
+
+    # 2) proactive refresh with mints IN FLIGHT (engine-side futures
+    # genuinely straddle the round; loopback settles the RPC ones inline)
+    inflight_msgs = [rand_fr() for _ in range(MSGS)]
+    esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+    sig_req, _ = eng.submit_prepare(inflight_msgs, epk).result(120.0)
+    inflight = [
+        eng.submit_mint(sig_req, inflight_msgs, esk) for _ in range(4)
+    ]
+    before = _share_map(ks1.signers)
+    ks1r = mgr.refresh()
+    assert ks1r.vk.to_bytes(params.ctx) == ks1.vk.to_bytes(params.ctx)
+    after = _share_map(ks1r.signers)
+    assert all(before[i] != after[i] for i in before)  # all shares moved
+    for f in inflight:  # straddling mints settle: no dangling futures
+        cred = f.result(120.0)
+        assert cred.epoch == 1
+        assert eng.submit_verify(cred, inflight_msgs).result(120.0) is True
+    mid = [mint_one() for _ in range(2)]
+    assert all(c.epoch == 1 for c, _ in mid)  # refresh kept the epoch
+
+    # 3) t/n reshare (3-of-5 -> 2-of-5) with mints in flight: a NEW
+    # epoch activates; straddlers complete under whichever epoch their
+    # fan-out pinned and verify under that stamp
+    inflight = [
+        eng.submit_mint(sig_req, inflight_msgs, esk) for _ in range(4)
+    ]
+    ks2 = mgr.reshare(threshold=2, total=5)
+    assert ks2.epoch == 2
+    assert ks2.vk.to_bytes(params.ctx) != ks1.vk.to_bytes(params.ctx)
+    for f in inflight:
+        cred = f.result(120.0)
+        assert cred.epoch in (1, 2)
+        assert eng.submit_verify(cred, inflight_msgs).result(120.0) is True
+    loop.step()
+    assert directory.epochs("r0") == ((1, RETIRING), (2, ACTIVE))
+
+    # 4) every pre-rollover credential verifies post-rollover under its
+    # mint epoch — full session, over the wire
+    for cred, msgs in pre + mid:
+        assert client.submit_verify(cred, msgs).result(120.0) is True
+        full_show(cred, msgs)
+    post = [mint_one() for _ in range(2)]
+    assert all(c.epoch == 2 for c, _ in post)
+    full_show(*post[0])
+
+    # 5) wrong-epoch verification REJECTS (verdict False, not a crash):
+    # an epoch-1 credential presented as epoch-2 fails under that verkey
+    cred, msgs = pre[0]
+    cred.epoch = 2
+    assert client.submit_verify(cred, msgs).result(120.0) is False
+    cred.epoch = 1
+
+    # 6) unknown epoch refuses typed through the RPC error envelope
+    cred.epoch = 42
+    with pytest.raises(EpochUnknownError):
+        client.submit_verify(cred, msgs).result(120.0)
+    cred.epoch = 1
+
+    # 7) window pressure: two more reshares retire epoch 1; its
+    # credentials now refuse typed (EpochRetiredError) over the wire
+    ks3 = mgr.reshare()
+    ks4 = mgr.reshare()
+    assert (ks3.epoch, ks4.epoch) == (3, 4)
+    loop.step()
+    assert directory.epochs("r0") == (
+        (2, RETIRING), (3, RETIRING), (4, ACTIVE),
+    )
+    with pytest.raises(EpochRetiredError) as ei:
+        client.submit_verify(cred, msgs).result(120.0)
+    # structured attrs don't survive the envelope, but the live set does
+    # travel in the message for client re-resolution
+    assert "live epochs: [2, 3, 4]" in str(ei.value)
+    # epoch-2 credentials still verify: retirement was window pressure,
+    # not a blanket invalidation
+    assert client.submit_verify(post[0][0], post[0][1]).result(120.0)
+
+    # -- the drill's verdicts ------------------------------------------------
+    assert len(settled) == 7  # every RPC mint settled exactly once
+    for e in (2, 3, 4):
+        assert mgr.registry.pin_count(e) == 0  # no leaked pins
+    assert metrics.get_count("gateway_errors") == 0  # no terminal errors
+    assert metrics.get_count("keylife_refreshes") == 1
+    assert metrics.get_count("keylife_reshares") == 3
+    assert metrics.get_count("keylife_retirements") == 1
+    assert eng.drain(timeout=60.0)
