@@ -23,6 +23,9 @@ Also measured (BASELINE.md configs):
   issue lane: loadgen against the online IssuanceService           [--issue]
   session lane: full-session loadgen against the ProtocolEngine    [--session]
   gateway lane: RPC-vs-direct goodput through the fleet gateway    [--gateway]
+  batchverify lane: RLC-combined vs exact verify/show-verify       [--batchverify]
+    (ISSUE 16 — B in BENCH_BATCHVERIFY_SIZES, crossover point,
+    <= 2 final exps per combined batch; BENCH_BATCHVERIFY=0 skips)
 
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
@@ -792,6 +795,131 @@ def bench_keylife(ge, params, extras, backend_name):
     return after["goodput_per_s"]
 
 
+def bench_batchverify(ge, params, vk, sigs, msgs_list, extras,
+                      backend_name):
+    """Batched-pairing-verification lane (--batchverify, ISSUE 16):
+    device time of the RLC-combined check (ONE multi-Miller product +
+    ONE shared final exponentiation per batch) vs the exact per-lane
+    path, for plain verify AND show-verify, at each batch width in
+    BENCH_BATCHVERIFY_SIZES (default 64,256,1024 — widths above the
+    fixture batch recycle fixture credentials). Embeds per-width
+    timings, speedups, the smallest width where batched wins
+    ("crossover_b"), and the soundness parameter under
+    extras["batchverify"]; asserts every combined batch cost <= 2 final
+    exponentiations (the "verify_final_exps" counter delta) while the
+    exact path cost B, and that all-valid verdict vectors are
+    bit-identical across modes. Knobs: BENCH_BATCHVERIFY_REPS
+    (default 3); BENCH_BATCHVERIFY=0 skips. Returns the verify speedup
+    at the widest batch."""
+    from coconut_tpu import metrics, pok_sig, ps
+    from coconut_tpu.backend import get_backend
+    from coconut_tpu.batchverify import batch_lambda
+
+    reps = int(os.environ.get("BENCH_BATCHVERIFY_REPS", "3"))
+    sizes = sorted(
+        int(x)
+        for x in os.environ.get(
+            "BENCH_BATCHVERIFY_SIZES", "64,256,1024"
+        ).split(",")
+        if x.strip()
+    )
+    backend = get_backend(backend_name)
+    revealed = list(range(2, ge.MSG_COUNT))
+
+    max_b = max(sizes)
+    vsigs = [sigs[i % len(sigs)] for i in range(max_b)]
+    vmsgs = [msgs_list[i % len(msgs_list)] for i in range(max_b)]
+    proofs, challenges, revealed_list = pok_sig.batch_show(
+        vsigs, vk, params, vmsgs, revealed, backend=backend
+    )
+
+    def fexp_delta(fn):
+        base = metrics.get_count("verify_final_exps")
+        out = fn()
+        return metrics.get_count("verify_final_exps") - base, out
+
+    points = []
+    for B in sizes:
+        def v_exact():
+            return backend.batch_verify(
+                vsigs[:B], vmsgs[:B], vk, params
+            )
+
+        def v_batched():
+            return ps.batch_verify(
+                vsigs[:B], vmsgs[:B], vk, params,
+                backend=backend, mode="batched",
+            )
+
+        def s_exact():
+            return ps.batch_show_verify(
+                proofs[:B], vk, params, revealed_list[:B],
+                challenges=challenges[:B], backend=backend,
+                mode="exact",
+            )
+
+        def s_batched():
+            return ps.batch_show_verify(
+                proofs[:B], vk, params, revealed_list[:B],
+                challenges=challenges[:B], backend=backend,
+                mode="batched",
+            )
+
+        # warmup (jit compile), then pin the final-exp economics on one
+        # counted call each: exact pays B, combined pays <= 2
+        exact_fexp, exact_bits = fexp_delta(v_exact)
+        combined_fexp, batched_bits = fexp_delta(v_batched)
+        assert list(exact_bits) == list(batched_bits), (
+            "verdict vectors diverged at B=%d" % B
+        )
+        assert all(batched_bits), "fixture batch must be all-valid"
+        assert combined_fexp <= 2, (
+            "combined batch cost %d final exps at B=%d (want <= 2)"
+            % (combined_fexp, B)
+        )
+        show_fexp, show_batched_bits = fexp_delta(s_batched)
+        assert show_fexp <= 2, (
+            "combined show batch cost %d final exps at B=%d (want <= 2)"
+            % (show_fexp, B)
+        )
+        assert list(show_batched_bits) == list(s_exact()), (
+            "show verdict vectors diverged at B=%d" % B
+        )
+
+        t_vexact, _ = _timeit(v_exact, reps)
+        t_vbatched, _ = _timeit(v_batched, reps)
+        t_sexact, _ = _timeit(s_exact, reps)
+        t_sbatched, _ = _timeit(s_batched, reps)
+        points.append({
+            "b": B,
+            "verify_exact_s": round(t_vexact, 4),
+            "verify_batched_s": round(t_vbatched, 4),
+            "verify_speedup": round(t_vexact / t_vbatched, 3),
+            "verify_exact_final_exps": exact_fexp,
+            "verify_batched_final_exps": combined_fexp,
+            "show_exact_s": round(t_sexact, 4),
+            "show_batched_s": round(t_sbatched, 4),
+            "show_speedup": round(t_sexact / t_sbatched, 3),
+            "show_batched_final_exps": show_fexp,
+        })
+
+    crossover = next(
+        (p["b"] for p in points if p["verify_speedup"] > 1.0), None
+    )
+    top = points[-1]
+    extras["batchverify"] = {
+        "lambda": batch_lambda(),
+        "sizes": sizes,
+        "points": points,
+        "crossover_b": crossover,
+        "verify_speedup_at_max_b": top["verify_speedup"],
+        "show_speedup_at_max_b": top["show_speedup"],
+        "batched_checks": metrics.get_count("verify_batched_checks"),
+        "batched_fallbacks": metrics.get_count("verify_batched_fallbacks"),
+    }
+    return top["verify_speedup"]
+
+
 def _bench_chaos_recovery(params, vk, pool, backend_name, mode, max_batch,
                           max_wait_ms):
     """Self-healing recovery datapoint (ISSUE 9): goodput before / during /
@@ -1007,6 +1135,10 @@ def main():
         "--keylife" in sys.argv[1:]
         and os.environ.get("BENCH_KEYLIFE", "1") == "1"
     )
+    batchverify_flag = (
+        "--batchverify" in sys.argv[1:]
+        and os.environ.get("BENCH_BATCHVERIFY", "1") == "1"
+    )
     # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
     # offline lanes so the CI online smokes don't pay for them
     offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
@@ -1016,6 +1148,7 @@ def main():
         or gateway_flag
         or lifecycle_flag
         or keylife_flag
+        or batchverify_flag
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1088,6 +1221,14 @@ def main():
         if value is None:
             value = keylife_goodput
             metric, unit = "keylife_rollover_goodput_per_sec", "requests/sec"
+
+    if batchverify_flag:
+        bv_speedup = bench_batchverify(
+            ge, params, vk, sigs, msgs_list, extras, backend_name
+        )
+        if value is None:
+            value = bv_speedup
+            metric, unit = "batchverify_speedup_at_max_batch", "x"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

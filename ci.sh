@@ -344,6 +344,53 @@ else
   echo "keylife bench smoke: skipped (BENCH_KEYLIFE=0)"
 fi
 
+echo "== batchverify lane (RLC combined pairing check / bisection fallback) =="
+# the marker suite: deterministic combiner derivation (same transcript ->
+# same exponents, cross-process), transcript domain separation (verkey /
+# epoch / lane content), batched-vs-exact bit-identical verdicts, forged-
+# lane attribution through the bisection ladder, the adversarial 100-draw
+# soundness sweeps (B in {16,256}) and the cancellation-pair attack, plus
+# the serve/engine "batched" program modes (pow2 jit-shape bucketing,
+# COCONUT_BATCH_VERIFY default, keychain refusal)
+python -m pytest tests/ -m batchverify -q
+# end-to-end acceptance smoke (ISSUE 16): a REAL CredentialService in
+# mode="batched" folds a 64-lane batch (one forged sigma_2) into ONE
+# combined pairing check, bisects the failure down to the culprit lane,
+# dead-letters it with program + lane index, and settles every survivor
+# True — then proves the steady state: an all-valid batch is ONE combined
+# check and ONE final exponentiation.
+JAX_PLATFORMS=cpu python probes/probe_batchverify.py
+# bench smoke: batched-vs-exact device time for verify AND show-verify,
+# asserted from the JSON artifact a human reads — the ISSUE 16 floor is
+# <= 2 final exponentiations per combined batch and a reported crossover.
+# BENCH_BATCHVERIFY=0 skips the lane.
+if [ "${BENCH_BATCHVERIFY:-1}" = "1" ]; then
+  BATCHV_JSON=$(mktemp -d)/batchverify.json
+  BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=8 BENCH_CHAOS=0 \
+    BENCH_BATCHVERIFY_SIZES=4,8 BENCH_BATCHVERIFY_REPS=1 JAX_PLATFORMS=cpu \
+    python bench.py --batchverify > "$BATCHV_JSON"
+  BATCHV_JSON_PATH="$BATCHV_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["BATCHV_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["batchverify"]
+assert report["points"], report
+for p in report["points"]:
+    assert p["verify_batched_final_exps"] <= 2, p
+    assert p["show_batched_final_exps"] <= 2, p
+assert report["batched_fallbacks"] == 0, report
+assert "crossover_b" in report, report
+print("batchverify bench smoke: ok (verify %.2fx, show %.2fx at B=%d, "
+      "crossover_b=%s)" % (
+          report["verify_speedup_at_max_b"],
+          report["show_speedup_at_max_b"],
+          report["points"][-1]["b"],
+          report["crossover_b"]))
+EOF
+else
+  echo "batchverify bench smoke: skipped (BENCH_BATCHVERIFY=0)"
+fi
+
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q
 # end-to-end acceptance smoke on the REAL service (CPU, stub backend):

@@ -100,9 +100,155 @@ class CurveBackend:
             for s, acc in zip(sigs, accs)
         ]
         bits = self.batch_verify_pairs(rows, params)
+        from . import metrics
+
+        metrics.count("verify_final_exps", len(rows))
         return [
             bool(b) and s.sigma_1 is not None for b, s in zip(bits, sigs)
         ]
+
+    def _msm_sig_distinct(self, params, points_batch, scalars_batch):
+        """Distinct-base MSM in whichever concrete group the ctx assigns
+        to signatures."""
+        if params.ctx.name == "G1":
+            return self.msm_g1_distinct(points_batch, scalars_batch)
+        return self.msm_g2_distinct(points_batch, scalars_batch)
+
+    def batch_verify_combined(
+        self, sigs, messages_list, vk, params, rs=None, epoch=None
+    ):
+        """ONE bool for the whole batch via the random-linear-combination
+        fold (PR 16): prod_i e(r_i sigma_1_i, acc_i) *
+        e(sum_i r_i (-sigma_2_i), g_tilde) == 1 — a single (B+1)-pair
+        pairing-product row instead of B independent 2-pair rows, so ONE
+        shared final exponentiation. `rs=None` derives the combiner
+        exponents deterministically from the domain-separated batch
+        transcript (batchverify.derive_combiners); soundness: a forged
+        lane survives w.p. <= 2^-lambda. Generic composition over the
+        MSM/pairing primitives — fused backends (JaxBackend) override."""
+        from . import metrics
+
+        metrics.count("verify_batched_checks")
+        B = len(sigs)
+        if B == 0:
+            return True  # empty product is 1
+        if any(s.sigma_1 is None or s.sigma_2 is None for s in sigs):
+            return False
+        if rs is None:
+            from .batchverify import derive_combiners, verify_transcript
+
+            rs = derive_combiners(
+                verify_transcript(sigs, messages_list, vk, params,
+                                  epoch=epoch),
+                B,
+            )
+        elif len(rs) != B:
+            raise ValueError(
+                "combiner count mismatch: %d exponents, %d lanes"
+                % (len(rs), B)
+            )
+        accs = self.verify_accumulators(vk, messages_list, params)
+        sig_ops = params.ctx.sig
+        s1r = self._msm_sig_distinct(
+            params, [[s.sigma_1] for s in sigs], [[r] for r in rs]
+        )
+        (z,) = self._msm_sig_distinct(
+            params,
+            [[sig_ops.neg(s.sigma_2) for s in sigs]],
+            [list(rs)],
+        )
+        row = list(zip(s1r, accs)) + [(z, params.g_tilde)]
+        ok = self.batch_verify_pairs([row], params)[0]
+        metrics.count("verify_final_exps", 1)
+        return bool(ok)
+
+    def batch_show_verify_combined(
+        self, proofs, vk, params, revealed_msgs_list, challenges, rs=None,
+        epoch=None
+    ):
+        """RLC-combined batched show verify -> (per-lane Schnorr bits,
+        ONE batch pairing bool). The Schnorr commitment equation stays
+        per-lane (MSM-only, nothing to combine); the B pairing checks
+        e(sigma'_1i, J_i * X_tilde * prod_rev Y^m) * e(-sigma'_2i,
+        g_tilde) fold under the combiner exponents as in
+        `batch_verify_combined`. Dead lanes (identity sigma') are
+        excluded from the fold and fail their own bit, so they never
+        poison the batch bool. A lane's verdict is bits[i] & pair_ok;
+        ps.batch_show_verify bisects on pair_ok=False. Generic
+        composition; fused backends override."""
+        from . import metrics
+
+        metrics.count("verify_batched_checks")
+        B = len(proofs)
+        if B == 0:
+            return [], True
+        ctx = params.ctx
+        oth = ctx.other
+        sig_ops = ctx.sig
+        schnorr = []
+        for p, c in zip(proofs, challenges):
+            ok = (
+                p.sigma_prime_1 is not None
+                and p.sigma_prime_2 is not None
+                and p.proof_vc.verify(oth, p._bases(vk, params), p.J, c)
+            )
+            schnorr.append(bool(ok))
+        if rs is None:
+            from .batchverify import derive_combiners, show_transcript
+
+            rs = derive_combiners(
+                show_transcript(proofs, vk, params, revealed_msgs_list,
+                                challenges, epoch=epoch),
+                B,
+            )
+        elif len(rs) != B:
+            raise ValueError(
+                "combiner count mismatch: %d exponents, %d lanes"
+                % (len(rs), B)
+            )
+        # zero the combiner of dead lanes: their pairing relation is
+        # excluded from the fold (they already fail via schnorr[i]=False)
+        live_rs = [
+            r if p.sigma_prime_1 is not None and p.sigma_prime_2 is not None
+            else 0
+            for r, p in zip(rs, proofs)
+        ]
+        # acc_i = J_i + X_tilde + sum_rev Y_tilde[j]^{m_j}
+        idx_sets = [sorted(rm.keys()) for rm in revealed_msgs_list]
+        bases = [vk.X_tilde] + [vk.Y_tilde[j] for j in idx_sets[0]]
+        if any(s != idx_sets[0] for s in idx_sets):
+            raise ValueError("combined show batch requires one revealed set")
+        scalars = [
+            [1] + [rm[j] % R for j in idx_sets[0]]
+            for rm in revealed_msgs_list
+        ]
+        msm_o = (
+            self.msm_g2_shared if ctx.name == "G1" else self.msm_g1_shared
+        )
+        accs = [
+            oth.add(a, p.J)
+            for a, p in zip(msm_o(bases, scalars), proofs)
+        ]
+        s1r = self._msm_sig_distinct(
+            params,
+            [[p.sigma_prime_1] for p in proofs],
+            [[r] for r in live_rs],
+        )
+        (z,) = self._msm_sig_distinct(
+            params,
+            [
+                [
+                    None if p.sigma_prime_2 is None
+                    else sig_ops.neg(p.sigma_prime_2)
+                    for p in proofs
+                ]
+            ],
+            [list(live_rs)],
+        )
+        row = list(zip(s1r, accs)) + [(z, params.g_tilde)]
+        pair_ok = self.batch_verify_pairs([row], params)[0]
+        metrics.count("verify_final_exps", 1)
+        return schnorr, bool(pair_ok)
 
 
 class PythonBackend(CurveBackend):

@@ -1,0 +1,171 @@
+"""Random-linear-combination (RLC) batch verification support (PR 16).
+
+Classical small-exponent batch verification (Bellare-Garay-Rabin '98;
+Ferrara-Green-Hohenberger-Pedersen '09 for pairing-based signatures):
+B independent PS pairing checks
+
+    e(sigma1_i, acc_i) * e(-sigma2_i, g_tilde) == 1        for every i
+
+collapse, under per-lane random exponents r_i, into ONE product
+
+    prod_i e(r_i * sigma1_i, acc_i) * e(sum_i r_i * (-sigma2_i), g_tilde)
+        == 1
+
+evaluated with a single multi-Miller loop and a SINGLE shared final
+exponentiation (B+1 pairs instead of 2B pairs and B final exps). A
+forged lane survives only if its pairing defect delta_i satisfies
+sum_i r_i * delta_i == 0 in GT's exponent group — probability <= 2^-lam
+over the r_i draw for any adversarial batch fixed before the draw.
+
+This module owns the two soundness-critical ingredients shared by every
+backend:
+
+  - `derive_combiners`: the r_i themselves, drawn DETERMINISTICALLY from
+    a domain-separated hash of the batch transcript (SHA-256 in counter
+    mode). Deterministic derivation keeps runs replayable (same batch ->
+    same exponents -> bit-identical verdicts across processes) while
+    remaining sound: the transcript commits to every signature, message,
+    verkey byte and the key epoch, so an adversary must choose its
+    forgery BEFORE learning the exponents — exactly the random-oracle
+    analogue of drawing them fresh (Fiat-Shamir applied to the batch
+    check).
+  - `verify_transcript` / `show_transcript`: the canonical byte strings
+    the exponents are derived from. Domain separation covers the check
+    flavor (verify vs show), lambda, the verkey, and the PR-15 key
+    epoch, so cross-epoch groups never share exponents even when the
+    refreshed verkey bytes coincide (proactive refresh preserves the
+    public key).
+
+The exponent width lam ("soundness bits") is configurable via
+COCONUT_BATCH_LAMBDA: default 128, floor 64 (the ISSUE's minimum),
+ceiling 128 (the device backends' signed-digit schedule for combiner
+scalars is sized for 128-bit magnitudes — `tpu/backend._R_RAND_BITS`).
+"""
+
+import hashlib
+import os
+
+from .ops.fields import R
+
+#: default soundness parameter: forged lanes survive w.p. <= ~2^-128
+DEFAULT_LAMBDA = 128
+#: hard floor — below this the combined check is not a verifier
+MIN_LAMBDA = 64
+#: ceiling — the TPU backend's combiner digit schedule is 128-bit
+MAX_LAMBDA = 128
+
+_DOMAIN_VERIFY = b"coconut-tpu/batchverify/v1/verify"
+_DOMAIN_SHOW = b"coconut-tpu/batchverify/v1/show"
+
+
+def batch_lambda():
+    """Resolve the soundness parameter from COCONUT_BATCH_LAMBDA.
+
+    Raises ValueError on anything below MIN_LAMBDA (a too-narrow
+    exponent silently weakens soundness — refuse loudly) or above
+    MAX_LAMBDA (wider than the device digit schedule can carry)."""
+    raw = os.environ.get("COCONUT_BATCH_LAMBDA")
+    if raw is None:
+        return DEFAULT_LAMBDA
+    lam = int(raw)
+    return _check_lambda(lam)
+
+
+def env_batched_default():
+    """True when COCONUT_BATCH_VERIFY selects the batched (RLC-combined)
+    verify path by default — the serve/engine mode knob. Accepts
+    "1"/"true"/"on"/"yes"/"batched" (case-insensitive); anything else,
+    including unset, keeps the exact per-lane default."""
+    raw = os.environ.get("COCONUT_BATCH_VERIFY", "")
+    return raw.strip().lower() in ("1", "true", "on", "yes", "batched")
+
+
+def _check_lambda(lam):
+    if not MIN_LAMBDA <= lam <= MAX_LAMBDA:
+        raise ValueError(
+            "COCONUT_BATCH_LAMBDA must be in [%d, %d] (got %r)"
+            % (MIN_LAMBDA, MAX_LAMBDA, lam)
+        )
+    return lam
+
+
+def derive_combiners(transcript, n, lam=None, domain=_DOMAIN_VERIFY):
+    """n deterministic nonzero combiner exponents r_i in [1, 2^lam - 1].
+
+    SHA-256 counter-mode XOF over a seed committing to the domain tag,
+    lam, and the batch transcript. r_i = (x_i mod (2^lam - 1)) + 1 where
+    x_i is a fresh 256-bit block — the modular fold's bias is < 2^-128,
+    irrelevant next to the 2^-lam soundness bound, and (unlike rejection
+    sampling) keeps lane i's exponent a pure function of (seed, i)."""
+    lam = batch_lambda() if lam is None else _check_lambda(lam)
+    seed = hashlib.sha256(
+        domain + b"|" + bytes([lam]) + b"|" + transcript
+    ).digest()
+    span = (1 << lam) - 1
+    out = []
+    for i in range(n):
+        block = hashlib.sha256(seed + i.to_bytes(8, "big")).digest()
+        out.append(int.from_bytes(block, "big") % span + 1)
+    return out
+
+
+def _absorb(h, tag, data):
+    """Length-prefixed component absorption — no concatenation ambiguity
+    between adjacent variable-length fields."""
+    h.update(tag)
+    h.update(len(data).to_bytes(4, "big"))
+    h.update(data)
+
+
+def _absorb_epoch(h, epoch):
+    if epoch is None:
+        h.update(b"E\x00")
+    else:
+        h.update(b"E\x01")
+        h.update(int(epoch).to_bytes(8, "big"))
+
+
+def verify_transcript(sigs, messages_list, vk, params, epoch=None):
+    """Canonical transcript digest for a plain batch-verify RLC draw.
+
+    Commits to the verkey bytes, the key epoch (PR 15 — proactive
+    refresh keeps the verkey bytes stable across epochs, so the epoch id
+    must be explicit), and every lane's signature + message vector. An
+    identity sigma is encoded as an empty component (those lanes are
+    rejected outright, never folded)."""
+    ctx = params.ctx
+    h = hashlib.sha256()
+    _absorb(h, b"D", _DOMAIN_VERIFY)
+    _absorb_epoch(h, epoch)
+    _absorb(h, b"K", vk.to_bytes(ctx))
+    h.update(len(sigs).to_bytes(4, "big"))
+    for sig, msgs in zip(sigs, messages_list):
+        _absorb(h, b"S", sig.to_bytes(ctx))
+        h.update(len(msgs).to_bytes(4, "big"))
+        for m in msgs:
+            h.update((m % R).to_bytes(32, "big"))
+    return h.digest()
+
+
+def show_transcript(proofs, vk, params, revealed_msgs_list, challenges,
+                    epoch=None):
+    """Canonical transcript digest for a batched show-verify RLC draw.
+
+    Commits to the verkey, epoch, every proof's wire bytes, its sorted
+    revealed-message map, and its Fiat-Shamir challenge."""
+    ctx = params.ctx
+    h = hashlib.sha256()
+    _absorb(h, b"D", _DOMAIN_SHOW)
+    _absorb_epoch(h, epoch)
+    _absorb(h, b"K", vk.to_bytes(ctx))
+    h.update(len(proofs).to_bytes(4, "big"))
+    for proof, revealed, chal in zip(proofs, revealed_msgs_list,
+                                     challenges):
+        _absorb(h, b"P", proof.to_bytes(ctx))
+        items = sorted(revealed.items())
+        h.update(len(items).to_bytes(4, "big"))
+        for idx, m in items:
+            h.update(int(idx).to_bytes(4, "big"))
+            h.update((m % R).to_bytes(32, "big"))
+        h.update((chal % R).to_bytes(32, "big"))
+    return h.digest()

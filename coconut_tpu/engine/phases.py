@@ -228,7 +228,13 @@ class ShowVerifyProgram(Program):
 
     def __init__(self, vk, params, backend=None, max_batch=64,
                  max_wait_ms=20.0, max_depth=1024, pad_partial=True,
-                 keychain=None):
+                 keychain=None, mode="exact"):
+        if mode not in ("exact", "batched"):
+            raise ValueError("unknown show-verify mode %r" % (mode,))
+        if mode == "batched" and backend is None:
+            raise ValueError(
+                "show-verify mode='batched' requires a backend"
+            )
         self.vk = vk
         self.params = params
         self.backend = backend
@@ -236,6 +242,10 @@ class ShowVerifyProgram(Program):
         self.max_wait_ms = max_wait_ms
         self.max_depth = max_depth
         self.pad_partial = pad_partial
+        #: "exact" re-checks every lane's two pairings; "batched" (PR 16)
+        #: folds the whole batch into ONE RLC-combined pairing product
+        #: with a shared final exponentiation, bisecting on rejection
+        self.mode = mode
         #: keylife.EpochRegistry: each ShowOrder's `epoch` picks the
         #: verkey its proof verifies (and re-hashes) against (PR 15)
         self.keychain = keychain
@@ -257,6 +267,7 @@ class ShowVerifyProgram(Program):
                 out = batch_show_verify(
                     proofs, self.vk, params, revealed_list,
                     challenges=challenges, backend=backend,
+                    mode=self.mode,
                 )
                 return lambda: out
             out = [False] * len(proofs)
@@ -268,12 +279,23 @@ class ShowVerifyProgram(Program):
                     [revealed_list[i] for i in idxs],
                     challenges=[challenges[i] for i in idxs],
                     backend=backend,
+                    mode=self.mode,
+                    epoch=epoch,
                 )
                 for i, b in zip(idxs, bits):
                     out[i] = bool(b)
             return lambda: out
 
         return dispatch, False
+
+    def shape_key(self, requests, payload_a, payload_b):
+        if self.mode == "batched":
+            # the combined show kernel clone-pads to a power of two —
+            # the jit-shape key is that padded width, not the raw count
+            from .core import _next_pow2
+
+            return ("batched", _next_pow2(max(1, len(payload_a))))
+        return super().shape_key(requests, payload_a, payload_b)
 
     def assemble(self, requests, bspan):
         from ..signature import fiat_shamir_challenge

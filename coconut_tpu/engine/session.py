@@ -77,11 +77,17 @@ class ProtocolEngine(ExecutionEngine):
         hedge=None,
         max_redispatch=None,
         keychain=None,
+        showv_mode=None,
     ):
         from ..backend import get_backend
+        from ..batchverify import env_batched_default
 
         if backend is None or isinstance(backend, str):
             backend = get_backend(backend or "python")
+        if showv_mode is None:
+            # COCONUT_BATCH_VERIFY=1 defaults the show-verify lane onto
+            # the RLC-combined pairing path (PR 16)
+            showv_mode = "batched" if env_batched_default() else "exact"
         signers = list(signers)
         if vk is None:
             vk = Verkey.aggregate(
@@ -139,7 +145,7 @@ class ProtocolEngine(ExecutionEngine):
         )
         self._showv = ShowVerifyProgram(
             vk, params, backend=backend, pad_partial=pad_partial,
-            keychain=keychain, **common
+            keychain=keychain, mode=showv_mode, **common
         )
         for prog in (self._prepare, self._prove, self._showv):
             self.register(prog)

@@ -82,8 +82,17 @@ class InjectedCrash(BaseException):
 
 # the verify entry points verify_stream._dispatchers probes for; faults are
 # injected only on these, everything else delegates untouched
-_SYNC_VERIFY = frozenset({"batch_verify", "batch_verify_grouped"})
-_ASYNC_VERIFY = frozenset({"batch_verify_async", "batch_verify_grouped_async"})
+_SYNC_VERIFY = frozenset({
+    "batch_verify",
+    "batch_verify_grouped",
+    "batch_verify_combined",
+    "batch_show_verify_combined",
+})
+_ASYNC_VERIFY = frozenset({
+    "batch_verify_async",
+    "batch_verify_grouped_async",
+    "batch_verify_combined_async",
+})
 
 
 class FaultyBackend:
@@ -256,6 +265,10 @@ class FaultyBackend:
         if idx in self.flip_on:
             if isinstance(result, list):
                 return [not b for b in result]
+            if isinstance(result, tuple) and len(result) == 2:
+                # batch_show_verify_combined's (schnorr bits, pairing ok)
+                bits, ok = result
+                return ([not b for b in bits], not ok)
             return not result
         return result
 

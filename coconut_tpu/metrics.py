@@ -17,6 +17,17 @@ exhausted), "bisections" (grouped-failure splits while isolating culprit
 credentials), "dead_letters" (culprits appended to the dead-letter JSONL),
 and "checkpoint_quarantined" (corrupt state files moved aside on resume).
 
+The RLC batch verifier (PR 16, coconut_tpu/batchverify.py + the
+backends' *_combined entry points) adds: "verify_batched_checks"
+(combined RLC predicate evaluations — one per batch plus one per
+bisection probe), "verify_batched_fallbacks" (combined batches that
+rejected and fell back to the bisection ladder), "verify_bisection_depth"
+(ladder splits while attributing a rejected combined batch — depth per
+incident is the delta across the fallback), and "verify_final_exps"
+(final exponentiations dispatched: B per exact batch, 1 per
+combined/grouped batch — the <=2-per-combined-batch bench assertion
+reads this counter's deltas).
+
 The encode pipeline reports here too: "encode_cache_hits" /
 "encode_cache_misses" (the backend's static-operand cache — comb tables,
 grouped point uploads, g_tilde — see tpu/backend._static_operands),

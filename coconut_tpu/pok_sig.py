@@ -9,7 +9,11 @@ item 4: the sequential prover dwarfed the batched verifier)."""
 
 from .ops.fields import R
 from .pok_vc import Proof
-from .ps import PoKOfSignature, PoKOfSignatureProof  # noqa: F401 (re-export)
+from .ps import (  # noqa: F401 (re-export)
+    PoKOfSignature,
+    PoKOfSignatureProof,
+    batch_show_verify,
+)
 from .signature import fiat_shamir_challenge
 from .sss import rand_fr
 
@@ -169,7 +173,12 @@ def show_verify(proof, vk, params, revealed_msgs, challenge=None):
     """Verifier side. When `challenge` is None the Fiat-Shamir challenge is
     recomputed from the proof transcript (the secure non-interactive path);
     passing it explicitly matches the reference's interactive-style tests
-    (pok_sig.rs:94-105)."""
+    (pok_sig.rs:94-105).
+
+    The batched verifier (ps.batch_show_verify, re-exported here) grows a
+    mode="batched" variant in PR 16: one RLC-combined pairing product +
+    shared final exponentiation for the whole batch, bisection fallback
+    on rejection. A single proof always verifies exactly."""
     if challenge is None:
         challenge = fiat_shamir_challenge(
             proof.to_bytes_for_challenge(vk, params)

@@ -44,38 +44,112 @@ def ps_verify(sig, messages, vk, params):
     )
 
 
-def batch_verify(sigs, messages_list, vk, params, backend=None):
+def batch_verify(sigs, messages_list, vk, params, backend=None,
+                 mode="exact", epoch=None):
     """Per-credential verification booleans for a batch under one verkey.
 
     `backend=None` runs the sequential reference path; a `CurveBackend`
     instance or name ("python", "jax") executes the same math through the
     batched seam (coconut_tpu/backend.py). This is the north-star entry
-    point (BASELINE.json configs 2 and 5)."""
+    point (BASELINE.json configs 2 and 5).
+
+    mode="batched" (PR 16) runs the probabilistic RLC-combined check —
+    ONE pairing product with ONE shared final exponentiation for the
+    whole batch — and, on a combined rejection, bisects with fresh
+    per-sub-batch combiner exponents to attribute the forged lane(s)
+    exactly (O(log B) extra combined checks). All-valid batches return a
+    verdict vector bit-identical to mode="exact". `epoch` is the PR-15
+    key epoch, folded into the exponent derivation's domain separation.
+    Requires a backend."""
     if len(sigs) != len(messages_list):
         raise PSError(
             "batch size mismatch: %d sigs, %d message vectors"
             % (len(sigs), len(messages_list))
         )
-    if backend is not None:
-        if isinstance(backend, str):
-            from .backend import get_backend
+    if mode not in ("exact", "batched"):
+        raise PSError("unknown verify mode %r" % (mode,))
+    if backend is not None and isinstance(backend, str):
+        from .backend import get_backend
 
-            backend = get_backend(backend)
+        backend = get_backend(backend)
+    if mode == "batched":
+        if backend is None:
+            raise PSError("mode='batched' requires a backend")
+        return _rlc_verify_bits(
+            sigs, messages_list, vk, params, backend, epoch
+        )
+    if backend is not None:
         return backend.batch_verify(sigs, messages_list, vk, params)
     return [
         ps_verify(s, m, vk, params) for s, m in zip(sigs, messages_list)
     ]
 
 
+def _rlc_verify_bits(sigs, messages_list, vk, params, backend, epoch):
+    """Batched-mode verdict vector: one combined RLC check, then — only
+    on rejection — the grouped-failure bisection ladder (PR 1 shape)
+    driven through the combined predicate. Every sub-batch check derives
+    FRESH exponents from its own transcript, so a cross-lane cancellation
+    crafted against one draw cannot survive the ladder. A single-lane
+    combined check is exactly equivalent to ps_verify (the lone exponent
+    is invertible mod R), which is what makes leaf verdicts — and
+    all-valid batches — bit-identical to the exact path."""
+    from . import metrics
+
+    B = len(sigs)
+
+    def combined(lo, hi):
+        return backend.batch_verify_combined(
+            sigs[lo:hi], messages_list[lo:hi], vk, params, epoch=epoch
+        )
+
+    bits = [True] * B
+    if B == 0 or combined(0, B):
+        return bits
+    metrics.count("verify_batched_fallbacks")
+
+    def rec(lo, hi):
+        # precondition: combined(lo, hi) rejected
+        if hi - lo == 1:
+            bits[lo] = False
+            return
+        metrics.count("verify_bisection_depth")
+        mid = (lo + hi) // 2
+        left_ok = combined(lo, mid)
+        right_ok = combined(mid, hi)
+        if left_ok and right_ok:
+            # residual <= 2^-lambda event (the parent draw collided) —
+            # settle the range exactly rather than trust either draw
+            for i in range(lo, hi):
+                bits[i] = ps_verify(sigs[i], messages_list[i], vk, params)
+            return
+        if not left_ok:
+            rec(lo, mid)
+        if not right_ok:
+            rec(mid, hi)
+
+    rec(0, B)
+    return bits
+
+
 def batch_show_verify(
-    proofs, vk, params, revealed_msgs_list, challenges=None, backend=None
+    proofs, vk, params, revealed_msgs_list, challenges=None, backend=None,
+    mode="exact", epoch=None
 ):
     """Batched `PoKOfSignatureProof.verify` (BASELINE config 3).
 
     challenges=None recomputes each Fiat-Shamir challenge from the proof
     transcript (the secure non-interactive path). A backend accelerates the
     uniform case (every proof reveals the same index set — the bench shape);
-    ragged batches fall back to the sequential path."""
+    ragged batches fall back to the sequential path.
+
+    mode="batched" (PR 16) keeps the Schnorr check per-lane but folds the
+    B pairing checks into ONE RLC-combined product with ONE shared final
+    exponentiation, bisecting with fresh exponents on rejection to
+    attribute the tampered lane(s). All-valid batches match mode="exact"
+    bit-for-bit. `epoch` joins the exponent derivation's domain
+    separation (PR 15). Requires a backend; ragged batches fall back to
+    the exact sequential path exactly as the exact mode does."""
     from .signature import fiat_shamir_challenge
 
     if len(proofs) != len(revealed_msgs_list):
@@ -83,6 +157,8 @@ def batch_show_verify(
             "batch size mismatch: %d proofs, %d revealed maps"
             % (len(proofs), len(revealed_msgs_list))
         )
+    if mode not in ("exact", "batched"):
+        raise PSError("unknown verify mode %r" % (mode,))
     if challenges is None:
         challenges = [
             fiat_shamir_challenge(p.to_bytes_for_challenge(vk, params))
@@ -100,11 +176,18 @@ def batch_show_verify(
         p.revealed_msg_indices == proofs[0].revealed_msg_indices
         for p in proofs
     )
+    if mode == "batched" and backend is None:
+        raise PSError("mode='batched' requires a backend")
     if backend is not None and uniform:
         if isinstance(backend, str):
             from .backend import get_backend
 
             backend = get_backend(backend)
+        if mode == "batched":
+            return _rlc_show_verify_bits(
+                proofs, vk, params, revealed_msgs_list, challenges,
+                backend, epoch,
+            )
         if hasattr(backend, "batch_show_verify"):
             return backend.batch_show_verify(
                 proofs, vk, params, revealed_msgs_list, challenges
@@ -120,6 +203,71 @@ def batch_show_verify(
         p.verify(vk, params, rm, c)
         for p, rm, c in zip(proofs, revealed_msgs_list, challenges)
     ]
+
+
+def _rlc_show_verify_bits(
+    proofs, vk, params, revealed_msgs_list, challenges, backend, epoch
+):
+    """Batched-mode show verdicts. The backend's combined check returns
+    (per-lane Schnorr bits, ONE batch pairing bool); a lane's verdict is
+    schnorr[i] & pairing. On a pairing rejection the bisection ladder
+    re-runs the combined check on halves — each sub-batch draws FRESH
+    exponents from its own transcript — until the tampered lane(s) are
+    named. Dead lanes (identity sigma') are excluded from the fold by
+    the backend and fail via their Schnorr bit, so they never trigger
+    (or hide inside) a bisection."""
+    from . import metrics
+
+    B = len(proofs)
+    if B == 0:
+        return []
+
+    def combined(lo, hi):
+        return backend.batch_show_verify_combined(
+            proofs[lo:hi], vk, params, revealed_msgs_list[lo:hi],
+            challenges[lo:hi], epoch=epoch,
+        )
+
+    schnorr, pair_ok = combined(0, B)
+    pair_bits = [pair_ok] * B
+    if not pair_ok:
+        metrics.count("verify_batched_fallbacks")
+
+        def exact_pair(i):
+            # the full exact verify (schnorr & pairing); the schnorr half
+            # is already known, so this settles the pairing half exactly
+            return proofs[i].verify(
+                vk, params, revealed_msgs_list[i], challenges[i]
+            )
+
+        def rec(lo, hi):
+            # precondition: combined(lo, hi) pairing rejected
+            if hi - lo == 1:
+                pair_bits[lo] = False
+                return
+            metrics.count("verify_bisection_depth")
+            mid = (lo + hi) // 2
+            _, left_ok = combined(lo, mid)
+            _, right_ok = combined(mid, hi)
+            if left_ok:
+                for i in range(lo, mid):
+                    pair_bits[i] = True
+            if right_ok:
+                for i in range(mid, hi):
+                    pair_bits[i] = True
+            if left_ok and right_ok:
+                # residual <= 2^-lambda collision in the parent draw:
+                # settle the range exactly
+                for i in range(lo, hi):
+                    pair_bits[i] = exact_pair(i)
+                return
+            if not left_ok:
+                rec(lo, mid)
+            if not right_ok:
+                rec(mid, hi)
+
+        rec(0, B)
+    return [bool(s) and bool(p) for s, p in zip(schnorr, pair_bits)]
 
 
 class PoKOfSignature:

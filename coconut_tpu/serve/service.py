@@ -74,9 +74,10 @@ class VerifyProgram(Program):
         bisector,
         keychain=None,
     ):
-        if keychain is not None and mode == "grouped":
-            # grouped mode folds the whole batch into one device bool;
-            # per-epoch verkeys need per-group dispatch, which defeats it
+        if keychain is not None and mode in ("grouped", "batched"):
+            # grouped/batched modes fold the whole batch into one device
+            # bool; per-epoch verkeys need per-group dispatch, which
+            # defeats it
             raise ValueError("keychain requires per_credential mode")
         self.backend = backend
         self.vk = vk
@@ -149,6 +150,15 @@ class VerifyProgram(Program):
             self.backend, self.mode, device=device
         )
         return dispatch, is_async
+
+    def shape_key(self, requests, payload_a, payload_b):
+        if self.mode == "batched":
+            # the combined kernel clone-pads lanes to a power of two
+            # internally (tpu/backend.batch_verify_combined) — key on
+            # THAT shape so varying coalesced sizes within one pow2
+            # bucket count as a single compiled program
+            return ("batched", _next_pow2(max(1, len(payload_a))))
+        return super().shape_key(requests, payload_a, payload_b)
 
     def assemble(self, requests, bspan):
         if self.pad_partial:
@@ -234,9 +244,14 @@ class CredentialService(ExecutionEngine):
     """Dynamic-batching verify service over any verify-capable backend.
 
     backend / fallback_backend: instances or registry names ("python",
-    "jax", ...). mode: "per_credential" (bits demux directly) or "grouped"
+    "jax", ...). mode: "per_credential" (bits demux directly), "grouped"
     (one device bool per batch; a rejection bisects to per-request
-    verdicts, culprits dead-lettered). max_batch: the coalesced device
+    verdicts, culprits dead-lettered), or "batched" (PR 16: ONE
+    RLC-combined pairing product + shared final exponentiation per batch,
+    same accept/bisect ladder as grouped but the bisection probes re-draw
+    combiners per sub-slice). mode=None resolves via COCONUT_BATCH_VERIFY
+    ("1"/"batched" -> "batched", else "per_credential").
+    max_batch: the coalesced device
     shape. max_wait_ms: default per-request coalescing deadline.
     max_depth: admission bound. pad_partial: identity-pad partial batches
     to max_batch (per_credential mode) so jit shapes stay cache-hot —
@@ -264,7 +279,7 @@ class CredentialService(ExecutionEngine):
         backend,
         vk,
         params,
-        mode="per_credential",
+        mode=None,
         max_batch=64,
         max_wait_ms=20.0,
         max_depth=1024,
@@ -289,7 +304,13 @@ class CredentialService(ExecutionEngine):
             backend = get_backend(backend or "python")
         if isinstance(fallback_backend, str):
             fallback_backend = get_backend(fallback_backend)
-        if mode not in ("per_credential", "grouped"):
+        if mode is None:
+            # COCONUT_BATCH_VERIFY=1 defaults new services onto the
+            # RLC-combined path (PR 16); unset keeps per_credential
+            from ..batchverify import env_batched_default
+
+            mode = "batched" if env_batched_default() else "per_credential"
+        if mode not in ("per_credential", "grouped", "batched"):
             raise ValueError("unknown serve mode %r" % (mode,))
 
         super().__init__(
@@ -341,8 +362,9 @@ class CredentialService(ExecutionEngine):
                 retry_policy,
                 dead_letter_path,
                 program="verify",
+                predicate="combined" if mode == "batched" else "grouped",
             )
-            if mode == "grouped"
+            if mode in ("grouped", "batched")
             else None
         )
 

@@ -364,6 +364,31 @@ def _dispatchers(backend, mode, mesh=None, device=None, mesh_pad_to=None):
             _record_grouped,
             async_fn is not None,
         )
+    if mode == "batched":
+        # RLC-combined pairing check (PR 16): same one-bool-per-batch
+        # result shape as grouped, but the verdict comes from ONE
+        # multi-Miller product under deterministic per-lane combiners
+        # with a single shared final exponentiation.
+        async_fn = getattr(backend, "batch_verify_combined_async", None)
+        if async_fn is None:
+            combined = getattr(backend, "batch_verify_combined", None)
+            if combined is None:
+                raise ValueError(
+                    "backend %r has no combined (RLC) verify" % (backend,)
+                )
+
+            def dispatch(s, m, vk, params):
+                ok = combined(s, m, vk, params)
+                return lambda: ok
+
+        else:
+            dispatch = async_fn
+
+        return (
+            _pin_to_device(dispatch, device),
+            _record_grouped,
+            async_fn is not None,
+        )
     raise ValueError("unknown stream mode %r" % (mode,))
 
 
@@ -397,15 +422,32 @@ def _fallback_dispatcher(backend, mode):
         return lambda s, m, vk, p: (
             lambda: all(backend.batch_verify(s, m, vk, p))
         )
+    if mode == "batched":
+        combined = getattr(backend, "batch_verify_combined", None)
+        if combined is not None:
+            return lambda s, m, vk, p: (
+                lambda: bool(combined(s, m, vk, p))
+            )
+        return lambda s, m, vk, p: (
+            lambda: all(backend.batch_verify(s, m, vk, p))
+        )
     return lambda s, m, vk, p: (lambda: backend.batch_verify(s, m, vk, p))
 
 
-def _group_oracle(backend, vk, params):
-    """slice -> bool probe for bisection: the backend's grouped verify if
-    it has one, else all() over its per-credential bits; None if the
+def _group_oracle(backend, vk, params, predicate="grouped"):
+    """slice -> bool probe for bisection. predicate="grouped" prefers the
+    backend's grouped verify; predicate="combined" prefers the RLC
+    combined check (PR 16) — each sub-slice gets FRESH exponents derived
+    from its own transcript, so a cancellation pair that fooled the
+    parent draw cannot survive both child draws except w.p. <= 2^-lam.
+    Either falls back to all() over per-credential bits; None if the
     backend can do neither."""
     if backend is None:
         return None
+    if predicate == "combined":
+        combined = getattr(backend, "batch_verify_combined", None)
+        if combined is not None:
+            return lambda s, m: bool(combined(s, m, vk, params))
     grouped = getattr(backend, "batch_verify_grouped", None)
     if grouped is not None:
         return lambda s, m: bool(grouped(s, m, vk, params))
@@ -417,20 +459,21 @@ def _group_oracle(backend, vk, params):
 
 def _make_bisector(
     backend, fallback_backend, vk, params, policy, dead_letter_path,
-    program=None,
+    program=None, predicate="grouped",
 ):
     """bisect(sigs, msgs, batch_index, attempts) -> culprit indices.
 
-    A rejected grouped batch is recursively halved; each slice is probed
-    with a grouped check (per-credential at single-credential leaves —
-    a 1-slice grouped check IS the per-credential verify), probes riding
-    the same retry/fallback ladder as regular dispatches. Culprits are
-    appended to the dead-letter JSONL with the batch's attempt history.
+    A rejected grouped (or RLC-combined, predicate="combined") batch is
+    recursively halved; each slice is probed with a grouped check
+    (per-credential at single-credential leaves — a 1-slice grouped
+    check IS the per-credential verify), probes riding the same
+    retry/fallback ladder as regular dispatches. Culprits are appended
+    to the dead-letter JSONL with the batch's attempt history.
     Counters: "bisections" per split, "dead_letters" per culprit."""
     from .retry import call_with_retry
 
-    primary = _group_oracle(backend, vk, params)
-    fb = _group_oracle(fallback_backend, vk, params)
+    primary = _group_oracle(backend, vk, params, predicate=predicate)
+    fb = _group_oracle(fallback_backend, vk, params, predicate=predicate)
     if primary is None:
         primary, fb = fb, None
     if primary is None:
@@ -618,8 +661,9 @@ def verify_stream(
       dead_letter_path  — JSONL file receiving culprit credentials from
                           grouped-failure bisection.
       bisect_failures   — force grouped-failure bisection on/off; default
-                          (None) enables it in grouped mode when a
-                          dead_letter_path is given. When a rejected
+                          (None) enables it in grouped and batched (RLC
+                          combined, PR 16) modes when a dead_letter_path
+                          is given. When a rejected
                           grouped batch is bisected, `failed` counts only
                           the culprits (granular accounting) while
                           `batches_failed` still counts the batch; the
@@ -663,11 +707,14 @@ def verify_stream(
             ),
         )
     if bisect_failures is None:
-        bisect_failures = mode == "grouped" and dead_letter_path is not None
+        bisect_failures = (
+            mode in ("grouped", "batched") and dead_letter_path is not None
+        )
     bisector = None
-    if bisect_failures and mode == "grouped":
+    if bisect_failures and mode in ("grouped", "batched"):
         bisector = _make_bisector(
-            backend, fallback_backend, vk, params, policy, dead_letter_path
+            backend, fallback_backend, vk, params, policy, dead_letter_path,
+            predicate="combined" if mode == "batched" else "grouped",
         )
 
     fingerprint = None

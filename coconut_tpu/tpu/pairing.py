@@ -100,7 +100,18 @@ def multi_miller_loop(px, py, qx, qy, valid):
     px, py: Fp limb arrays [...]; qx, qy: Fp2 pytrees (affine twist);
     valid: bool [...] — False lanes contribute the factor 1 (the spec's
     `None` -> FP12_ONE convention).
-    Returns an Fp12 pytree with the same leading dims [...]."""
+    Returns an Fp12 pytree with the same leading dims [...].
+
+    PAD-LANE CONTRACT (pinned by tests/test_ops.py's pad-lane
+    regressions; the RLC batch verifier of PR 16 leans on it): a lane
+    with valid=False contributes EXACTLY the GT identity to the product
+    — every one of its line evaluations is masked to (1, 0, 0) inside
+    _mask_line, so its point coordinates may be garbage (zeros,
+    off-curve, aliased) without perturbing the other lanes. All-pad pair
+    sets therefore fold to FP12_ONE, and ragged batches padded with
+    valid=0 lanes return bit-identical products to their unpadded
+    prefix, regardless of where the pad lanes sit (trailing or
+    interleaved)."""
     shape = valid.shape
     T0 = (qx, qy, tw.fp2_ones(shape))
     f0 = tw.fp12_ones(shape)
@@ -143,7 +154,10 @@ def _index_fp12(f, i):
 def _mask_line(line, valid):
     """Select the identity line (1, 0, 0) on invalid lanes so a dead pair
     contributes the factor 1 to the merged accumulator (the generic loop's
-    post-hoc fp12 select, pushed down to the sparse element)."""
+    post-hoc fp12 select, pushed down to the sparse element). This is the
+    mechanism behind multi_miller_loop's pad-lane contract: masking every
+    LINE (rather than the final fp12) keeps a valid=0 lane's garbage
+    coordinates out of the product at every step, not just at the end."""
     lA, lB, lC = line
     one = tw.fp2_ones(valid.shape)
     zero = tw.fp2_zeros(valid.shape)
@@ -241,6 +255,11 @@ def final_exp(f):
 
 
 def pairing_product_is_one(px, py, qx, qy, valid):
-    """[..., npairs] pair sets -> bool [...]: prod e(P_i, Q_i) == 1."""
+    """[..., npairs] pair sets -> bool [...]: prod e(P_i, Q_i) == 1.
+
+    Inherits multi_miller_loop's pad-lane contract: valid=0 pairs are
+    identity factors, so an all-pad set answers True (empty product) and
+    pad lanes never change a batch's verdict — the invariant the PR-16
+    combined verifier's clone-first power-of-two padding relies on."""
     f = multi_miller_loop(px, py, qx, qy, valid)
     return tw.fp12_is_one(final_exp(f))

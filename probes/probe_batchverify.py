@@ -1,0 +1,167 @@
+"""Batched-verify acceptance smoke (the PR-16 RLC combined-check lane).
+
+    JAX_PLATFORMS=cpu python probes/probe_batchverify.py
+
+Runs a REAL serve.CredentialService in mode="batched" on the python
+backend: 64 credentials (one forged sigma_2) submitted as ONE combined
+batch, verified by a single random-linear-combination pairing check
+that FAILS loudly and is then bisected (predicate="combined", fresh
+per-sub-batch exponents) down to the culprit lane. Asserts the
+properties ISSUE 16 promises:
+
+  - the forged lane's future ALONE settles False; all 63 survivors
+    settle True through the same batch;
+  - the dead-letter record carries the program name ("verify") and the
+    exact lane index of the culprit;
+  - attribution is cheap: O(log B) combined re-checks, so the total
+    final-exponentiation count stays well under the exact path's B;
+  - a second, all-valid batch needs exactly ONE combined check and ONE
+    final exponentiation — the steady-state fast path.
+
+Prints a one-line JSON report (check/fallback/final-exp counters +
+timings) for the CI log. PROBE_BATCHVERIFY_LANES overrides the batch
+width (default 64). Runs on the CPU in well under a minute.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from coconut_tpu import metrics
+from coconut_tpu.backend import get_backend
+from coconut_tpu.faults import DeadLetterLog
+from coconut_tpu.ops.fields import R
+from coconut_tpu.params import Params
+from coconut_tpu.serve.service import CredentialService
+from coconut_tpu.signature import Signature, Sigkey, Verkey
+
+LANES = int(os.environ.get("PROBE_BATCHVERIFY_LANES", "64"))
+FORGED = LANES // 2 + 1  # an arbitrary interior lane
+Q = 1  # single-message credentials keep the python backend fast
+
+rng = random.Random(0xB16C64)
+
+
+def _keypair(params):
+    sk = Sigkey(rng.randrange(1, R), [rng.randrange(1, R) for _ in range(Q)])
+    ops = params.ctx.other
+    vk = Verkey(
+        ops.mul(params.g_tilde, sk.x),
+        [ops.mul(params.g_tilde, y) for y in sk.y],
+    )
+    return sk, vk
+
+
+def _sign(sk, msgs, params):
+    ops = params.ctx.sig
+    s1 = ops.mul(params.g, rng.randrange(1, R))
+    expo = (sk.x + sum(y * m for y, m in zip(sk.y, msgs))) % R
+    return Signature(s1, ops.mul(s1, expo))
+
+
+def main():
+    metrics.reset()
+    t0 = time.perf_counter()
+    params = Params.new(Q, b"probe-batchverify")
+    sk, vk = _keypair(params)
+    backend = get_backend("python")
+
+    msgs_list = [[rng.randrange(R)] for _ in range(LANES)]
+    sigs = [_sign(sk, m, params) for m in msgs_list]
+    # forge ONE lane: shift sigma_2 off the PS relation by +g
+    bad = sigs[FORGED]
+    sigs[FORGED] = Signature(
+        bad.sigma_1, params.ctx.sig.add(bad.sigma_2, params.g)
+    )
+
+    dlq = os.path.join(
+        os.environ.get("TMPDIR", "/tmp"),
+        "probe_batchverify_dead.%d.jsonl" % os.getpid(),
+    )
+    if os.path.exists(dlq):
+        os.unlink(dlq)
+
+    svc = CredentialService(
+        backend,
+        vk,
+        params,
+        mode="batched",
+        max_batch=LANES,
+        max_wait_ms=50.0,
+        dead_letter_path=dlq,
+    ).start()
+    try:
+        futs = [svc.submit(s, m) for s, m in zip(sigs, msgs_list)]
+        verdicts = [f.result(timeout=300.0) for f in futs]
+    finally:
+        assert svc.drain(timeout=60.0)
+    t_forged = time.perf_counter() - t0
+
+    # the forged lane's future ALONE fails; every survivor settles True
+    expected = [i != FORGED for i in range(LANES)]
+    assert verdicts == expected, (
+        "verdict demux broken: forged=%d got %r"
+        % (FORGED, [i for i, v in enumerate(verdicts) if not v])
+    )
+
+    # dead-letter carries the program name and the exact lane index
+    records = DeadLetterLog.read(dlq)
+    assert len(records) == 1, records
+    assert records[0]["program"] == "verify", records
+    assert records[0]["credential"] == FORGED, records
+    assert metrics.get_count("dead_letters") == 1
+
+    # attribution was bisection, not per-lane: O(log B) combined checks,
+    # each ONE final exponentiation — far fewer than the exact path's B
+    checks = metrics.get_count("verify_batched_checks")
+    fexps = metrics.get_count("verify_final_exps")
+    assert checks >= 2, checks  # the batch + at least one probe
+    assert fexps < LANES, (fexps, LANES)
+
+    # steady state: an all-valid batch is ONE combined check + ONE
+    # final exponentiation
+    metrics.reset()
+    good = [_sign(sk, m, params) for m in msgs_list]
+    t1 = time.perf_counter()
+    svc2 = CredentialService(
+        backend, vk, params, mode="batched", max_batch=LANES,
+        max_wait_ms=50.0, dead_letter_path=dlq,
+    ).start()
+    try:
+        futs = [svc2.submit(s, m) for s, m in zip(good, msgs_list)]
+        assert all(f.result(timeout=300.0) for f in futs)
+    finally:
+        assert svc2.drain(timeout=60.0)
+    t_clean = time.perf_counter() - t1
+    assert metrics.get_count("verify_batched_checks") == 1
+    assert metrics.get_count("verify_final_exps") == 1
+    assert len(DeadLetterLog.read(dlq)) == 1  # no new dead letters
+
+    os.unlink(dlq)
+    print(
+        json.dumps(
+            {
+                "lanes": LANES,
+                "forged_lane": FORGED,
+                "bisection_checks": checks,
+                "forged_final_exps": fexps,
+                "clean_final_exps": 1,
+                "forged_batch_s": round(t_forged, 3),
+                "clean_batch_s": round(t_clean, 3),
+            },
+            sort_keys=True,
+        )
+    )
+    print(
+        "batchverify probe: ok (%d lanes, forged lane %d attributed in "
+        "%d combined checks)" % (LANES, FORGED, checks)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -110,6 +110,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "batchverify: RLC combined-pairing batch verification suite "
+        "(deterministic combiner derivation, pad-lane contract, "
+        "adversarial soundness + bisection attribution, engine batched "
+        "mode), also run explicitly by ci.sh's batchverify lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

@@ -170,3 +170,206 @@ class TestWireFuzz:
         b = params.to_bytes()
         with pytest.raises(DeserializationError):
             Params.from_bytes(b[:-5], ctx)
+
+
+# --- RLC batch-verification soundness (PR 16) -------------------------------
+
+
+class TestRLCSoundness:
+    """Adversarial suite for the combined (random-linear-combination)
+    batch verifier. The many-draw sweeps run against an ALGEBRAIC model
+    of the combined predicate — a batch of lane defects delta_i in Z_r
+    passes iff sum_i r_i * delta_i == 0 mod r, which is exactly the
+    GT-exponent-group condition the real pairing product evaluates —
+    driven through a faithful mirror of ps._rlc_verify_bits' bisection
+    ladder (fresh derived exponents per sub-transcript). Real-crypto
+    single-draw attribution runs at B=16 on the python backend; the
+    cancellation pair demonstrates, on real pairings, that the all-ones
+    combination is NOT a verifier while the derived RLC is."""
+
+    pytestmark = pytest.mark.batchverify
+
+    # -- the algebraic mirror ------------------------------------------------
+
+    @staticmethod
+    def _sim_bits(defects, seed):
+        """Mirror of ps._rlc_verify_bits over defect exponents."""
+        import hashlib
+
+        from coconut_tpu.batchverify import derive_combiners
+
+        B = len(defects)
+
+        def combined(lo, hi):
+            t = hashlib.sha256(
+                b"sim|%d|%d|%d|" % (seed, lo, hi)
+                + b"".join(d.to_bytes(32, "big") for d in defects[lo:hi])
+            ).digest()
+            rs = derive_combiners(t, hi - lo)
+            return (
+                sum(r * d for r, d in zip(rs, defects[lo:hi])) % R == 0
+            )
+
+        bits = [True] * B
+        if B == 0 or combined(0, B):
+            return bits
+
+        def rec(lo, hi):
+            if hi - lo == 1:
+                bits[lo] = False
+                return
+            mid = (lo + hi) // 2
+            left_ok = combined(lo, mid)
+            right_ok = combined(mid, hi)
+            if left_ok and right_ok:
+                for i in range(lo, hi):
+                    bits[i] = defects[i] == 0
+                return
+            if not left_ok:
+                rec(lo, mid)
+            if not right_ok:
+                rec(mid, hi)
+
+        rec(0, B)
+        return bits
+
+    @pytest.mark.parametrize("B", [16, 256])
+    def test_forged_lanes_attributed_across_100_draws(self, B):
+        # >= 100 independent seeded exponent draws per batch width; every
+        # draw must reject AND name exactly the forged lanes
+        local = random.Random(0x51C)
+        for draw in range(100):
+            n_bad = local.randrange(1, min(6, B))
+            bad = set(local.sample(range(B), n_bad))
+            defects = [
+                local.randrange(1, R) if i in bad else 0 for i in range(B)
+            ]
+            bits = self._sim_bits(defects, seed=draw)
+            assert bits == [i not in bad for i in range(B)], (
+                "draw %d misattributed" % draw
+            )
+
+    @pytest.mark.parametrize("B", [16, 256])
+    def test_all_valid_accepts_every_draw(self, B):
+        for draw in range(100):
+            assert self._sim_bits([0] * B, seed=draw) == [True] * B
+
+    def test_cancellation_pair_simulated(self):
+        # defects d and R-d cancel under the all-ones combination but
+        # not under any draw with r_0 != r_1
+        local = random.Random(0xCA7)
+        for draw in range(100):
+            d = local.randrange(1, R)
+            defects = [d, R - d] + [0] * 14
+            assert (defects[0] + defects[1]) % R == 0  # all-ones blind
+            bits = self._sim_bits(defects, seed=draw)
+            assert bits == [False, False] + [True] * 14, (
+                "draw %d: cancellation pair survived" % draw
+            )
+
+
+class TestRLCSoundnessRealCrypto:
+    """Single-draw real-pairing attribution at B=16 on the python
+    backend, plus the real cancellation pair."""
+
+    pytestmark = pytest.mark.batchverify
+
+    B = 16
+    Q = 2
+
+    @pytest.fixture(scope="class")
+    def world(self):
+        from coconut_tpu.backend import get_backend
+        from coconut_tpu.signature import Sigkey, Verkey
+
+        local = random.Random(0xF06)
+        params = Params.new(self.Q, b"rlc-adversarial")
+        sk = Sigkey(
+            local.randrange(1, R),
+            [local.randrange(1, R) for _ in range(self.Q)],
+        )
+        ops = params.ctx.other
+        vk = Verkey(
+            ops.mul(params.g_tilde, sk.x),
+            [ops.mul(params.g_tilde, y) for y in sk.y],
+        )
+
+        def sign(msgs):
+            t = local.randrange(1, R)
+            s1 = params.ctx.sig.mul(params.g, t)
+            expo = (sk.x + sum(y * m for y, m in zip(sk.y, msgs))) % R
+            return Signature(s1, params.ctx.sig.mul(s1, expo))
+
+        msgs_list = [
+            [local.randrange(R) for _ in range(self.Q)]
+            for _ in range(self.B)
+        ]
+        sigs = [sign(m) for m in msgs_list]
+        return get_backend("python"), params, vk, sigs, msgs_list
+
+    def test_forged_sigma_and_wrong_message_attributed(self, world):
+        from coconut_tpu import ps
+
+        be, params, vk, sigs, msgs_list = world
+        bad = list(sigs)
+        bad[7] = Signature(
+            bad[7].sigma_1, params.ctx.sig.mul(bad[7].sigma_2, 5)
+        )
+        wrong = [list(m) for m in msgs_list]
+        wrong[11][0] = (wrong[11][0] + 1) % R
+        bits = ps.batch_verify(
+            bad, wrong, vk, params, backend=be, mode="batched"
+        )
+        assert bits == [i not in (7, 11) for i in range(self.B)]
+
+    def test_tampered_show_proof_attributed(self, world):
+        from coconut_tpu.pok_sig import batch_show_verify, show
+
+        be, params, vk, sigs, msgs_list = world
+        n = 8
+        proofs, challenges, revealed = [], [], []
+        for s, m in zip(sigs[:n], msgs_list[:n]):
+            p, c, rv = show(s, vk, params, m, [0])
+            proofs.append(p)
+            challenges.append(c)
+            revealed.append(rv)
+        # tamper lane 5's proof: swap in a different lane's challenge so
+        # its Schnorr equation still holds per-lane but the transcript
+        # binding breaks -> exact path False; batched must agree
+        rv2 = [dict(r) for r in revealed]
+        rv2[5][0] = (rv2[5][0] + 1) % R
+        bits = batch_show_verify(
+            proofs, vk, params, rv2, challenges=challenges,
+            backend=be, mode="batched",
+        )
+        exact = batch_show_verify(
+            proofs, vk, params, rv2, challenges=challenges,
+            backend=be, mode="exact",
+        )
+        assert bits == exact == [i != 5 for i in range(n)]
+
+    def test_cancellation_pair_real_pairings(self, world):
+        from coconut_tpu import ps
+
+        be, params, vk, sigs, msgs_list = world
+        ops = params.ctx.sig
+        P = ops.mul(params.g, 0xD15EA5E)
+        tampered = [
+            Signature(sigs[0].sigma_1, ops.add(sigs[0].sigma_2, P)),
+            Signature(sigs[1].sigma_1, ops.add(sigs[1].sigma_2, ops.neg(P))),
+        ]
+        pair_msgs = msgs_list[:2]
+        # under the all-ones combination the two defects cancel: the
+        # combined product accepts a batch with TWO forged lanes — the
+        # blind spot that makes fixed combiners a non-verifier
+        assert be.batch_verify_combined(
+            tampered, pair_msgs, vk, params, rs=[1, 1]
+        ) is True
+        # both lanes are genuinely forged
+        assert ps.batch_verify(tampered, pair_msgs, vk, params) == (
+            [False, False]
+        )
+        # the derived RLC draw catches and attributes both
+        assert ps.batch_verify(
+            tampered, pair_msgs, vk, params, backend=be, mode="batched"
+        ) == [False, False]

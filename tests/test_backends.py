@@ -312,6 +312,32 @@ class TestBatchShowVerify:
         got = batch_show_verify(proofs, keypair[1], params, rmls, backend="jax")
         assert got == seq
 
+    @heavy
+    def test_jax_combined_matches_sequential(self, params, keypair):
+        """mode="batched" through the fused RLC show kernel
+        (fused_show_verify_combined): the mixed batch (one valid, one
+        wrong-revealed, one corrupted-Schnorr lane) must attribute each
+        bad lane exactly as the sequential spec path does, and an
+        all-valid batch must accept through the ONE-final-exp fold."""
+        from coconut_tpu.ps import batch_show_verify
+
+        proofs, rmls = self._make(params, keypair, 4)
+        seq = batch_show_verify(proofs, keypair[1], params, rmls)
+        got = batch_show_verify(
+            proofs, keypair[1], params, rmls, backend="jax", mode="batched"
+        )
+        assert got == seq
+        # all-valid lanes only: the combined check passes first try
+        good = [i for i, b in enumerate(seq) if b]
+        assert batch_show_verify(
+            [proofs[i] for i in good],
+            keypair[1],
+            params,
+            [rmls[i] for i in good],
+            backend="jax",
+            mode="batched",
+        ) == [True] * len(good)
+
 
 class TestBatchProver:
     """Batched prover side (VERDICT r2 item 4): batch_show and

@@ -1,0 +1,450 @@
+"""RLC combined-pairing batch verification (PR 16).
+
+Covers the soundness-critical plumbing around the combined check:
+deterministic combiner derivation (replayable across processes,
+domain-separated by check flavor / verkey / PR-15 epoch), the batched
+ps-layer mode (one combined pairing product, bisection-on-rejection with
+exact attribution, verdict vectors bit-identical to the exact path), the
+serve-layer "batched" mode's demux invariant, and the
+COCONUT_BATCH_VERIFY / COCONUT_BATCH_LAMBDA knobs. The adversarial
+soundness suite (forged/cancellation lanes over many seeded draws) lives
+in test_adversarial.py; the device-kernel pad-lane contract in
+test_ops.py."""
+
+import random
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import pytest
+
+from coconut_tpu import metrics, ps
+from coconut_tpu.backend import get_backend
+from coconut_tpu.batchverify import (
+    DEFAULT_LAMBDA,
+    MAX_LAMBDA,
+    MIN_LAMBDA,
+    batch_lambda,
+    derive_combiners,
+    env_batched_default,
+    show_transcript,
+    verify_transcript,
+)
+from coconut_tpu.errors import PSError
+from coconut_tpu.faults import DeadLetterLog
+from coconut_tpu.ops.fields import R
+from coconut_tpu.params import Params
+from coconut_tpu.pok_sig import batch_show_verify, show
+from coconut_tpu.serve.service import CredentialService
+from coconut_tpu.signature import Signature, Sigkey, Verkey
+
+pytestmark = pytest.mark.batchverify
+
+rng = random.Random(0xB16C)
+
+Q = 3
+B = 8
+
+
+@pytest.fixture(scope="module")
+def params():
+    return Params.new(Q, b"batchverify-test")
+
+
+@pytest.fixture(scope="module")
+def keypair(params):
+    sk = Sigkey(
+        rng.randrange(1, R), [rng.randrange(1, R) for _ in range(Q)]
+    )
+    ops = params.ctx.other
+    vk = Verkey(
+        ops.mul(params.g_tilde, sk.x),
+        [ops.mul(params.g_tilde, y) for y in sk.y],
+    )
+    return sk, vk
+
+
+def _direct_sign(sk, msgs, params):
+    ops = params.ctx.sig
+    t = rng.randrange(1, R)
+    s1 = ops.mul(params.g, t)
+    expo = (sk.x + sum(y * m for y, m in zip(sk.y, msgs))) % R
+    return Signature(s1, ops.mul(s1, expo))
+
+
+@pytest.fixture(scope="module")
+def valid_batch(params, keypair):
+    sk, _ = keypair
+    msgs_list = [
+        [rng.randrange(R) for _ in range(Q)] for _ in range(B)
+    ]
+    sigs = [_direct_sign(sk, m, params) for m in msgs_list]
+    return sigs, msgs_list
+
+
+@pytest.fixture(scope="module")
+def pybe():
+    return get_backend("python")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_metrics():
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+# --- deterministic combiner derivation --------------------------------------
+
+
+class TestCombinerDerivation:
+    def test_same_transcript_same_exponents(self):
+        t = b"\x01" * 32
+        a = derive_combiners(t, 16)
+        b = derive_combiners(t, 16)
+        assert a == b
+        assert all(1 <= r < (1 << DEFAULT_LAMBDA) for r in a)
+        # prefixes agree: lane i's exponent is a pure function of
+        # (seed, i), independent of the batch width
+        assert derive_combiners(t, 4) == a[:4]
+
+    def test_cross_process_determinism(self):
+        t = b"\x5a" * 32
+        here = derive_combiners(t, 6)
+        code = (
+            "from coconut_tpu.batchverify import derive_combiners;"
+            "print(derive_combiners(bytes([0x5a])*32, 6))"
+        )
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, check=True,
+        ).stdout.strip()
+        assert out == str(here)
+
+    def test_different_transcripts_different_exponents(self):
+        assert derive_combiners(b"a" * 32, 4) != derive_combiners(
+            b"b" * 32, 4
+        )
+
+    def test_lambda_narrows_range(self):
+        rs = derive_combiners(b"t" * 32, 64, lam=MIN_LAMBDA)
+        assert all(1 <= r < (1 << MIN_LAMBDA) for r in rs)
+        # and the draw itself is domain-separated by lambda
+        assert rs != derive_combiners(b"t" * 32, 64, lam=MAX_LAMBDA)
+
+    def test_lambda_env_knob(self, monkeypatch):
+        monkeypatch.setenv("COCONUT_BATCH_LAMBDA", "64")
+        assert batch_lambda() == 64
+        monkeypatch.delenv("COCONUT_BATCH_LAMBDA")
+        assert batch_lambda() == DEFAULT_LAMBDA
+
+    @pytest.mark.parametrize("bad", ["32", "63", "129", "0"])
+    def test_lambda_out_of_range_refused(self, monkeypatch, bad):
+        monkeypatch.setenv("COCONUT_BATCH_LAMBDA", bad)
+        with pytest.raises(ValueError):
+            batch_lambda()
+
+    def test_env_batched_default(self, monkeypatch):
+        for raw, want in [
+            ("1", True), ("batched", True), ("TRUE", True),
+            ("0", False), ("", False), ("exact", False),
+        ]:
+            monkeypatch.setenv("COCONUT_BATCH_VERIFY", raw)
+            assert env_batched_default() is want
+        monkeypatch.delenv("COCONUT_BATCH_VERIFY")
+        assert env_batched_default() is False
+
+
+class TestTranscriptSeparation:
+    def test_verkey_separation(self, params, keypair, valid_batch):
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        ops = params.ctx.other
+        vk2 = Verkey(
+            ops.mul(params.g_tilde, 7),
+            [ops.mul(params.g_tilde, 7 + i) for i in range(Q)],
+        )
+        t1 = verify_transcript(sigs, msgs_list, vk, params)
+        t2 = verify_transcript(sigs, msgs_list, vk2, params)
+        assert t1 != t2
+        assert derive_combiners(t1, B) != derive_combiners(t2, B)
+
+    def test_epoch_separation(self, params, keypair, valid_batch):
+        # PR 15: proactive refresh preserves the verkey bytes, so the
+        # epoch id must separate draws on its own
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        ts = [
+            verify_transcript(sigs, msgs_list, vk, params, epoch=e)
+            for e in (None, 0, 1)
+        ]
+        assert len(set(ts)) == 3
+
+    def test_lane_content_bound(self, params, keypair, valid_batch):
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        t1 = verify_transcript(sigs, msgs_list, vk, params)
+        tampered = [list(m) for m in msgs_list]
+        tampered[3][0] = (tampered[3][0] + 1) % R
+        assert t1 != verify_transcript(sigs, tampered, vk, params)
+
+    def test_show_domain_separated_from_verify(self, params, keypair,
+                                               valid_batch):
+        # even with identical absorbed bytes downstream, the leading
+        # domain tag splits the two check flavors
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        proofs, challenges, revealed = [], [], []
+        for s, m in zip(sigs[:2], msgs_list[:2]):
+            p, c, rv = show(s, vk, params, m, [0])
+            proofs.append(p)
+            challenges.append(c)
+            revealed.append(rv)
+        tv = verify_transcript(sigs[:2], msgs_list[:2], vk, params)
+        tsu = show_transcript(proofs, vk, params, revealed, challenges)
+        assert tv != tsu
+
+
+# --- the ps-layer batched mode ----------------------------------------------
+
+
+class TestBatchedVerify:
+    def test_all_valid_bit_identical_to_exact(self, params, keypair,
+                                              valid_batch, pybe):
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        exact = ps.batch_verify(
+            sigs, msgs_list, vk, params, backend=pybe, mode="exact"
+        )
+        batched = ps.batch_verify(
+            sigs, msgs_list, vk, params, backend=pybe, mode="batched"
+        )
+        assert batched == exact == [True] * B
+        # an accepted batch costs exactly one combined check, no ladder
+        assert metrics.get_count("verify_batched_fallbacks") == 0
+        assert metrics.get_count("verify_bisection_depth") == 0
+
+    def test_forged_lanes_attributed(self, params, keypair, valid_batch,
+                                     pybe):
+        sk, vk = keypair
+        sigs, msgs_list = valid_batch
+        bad = list(sigs)
+        bad[3] = Signature(
+            bad[3].sigma_1, params.ctx.sig.mul(bad[3].sigma_2, 2)
+        )
+        wrong = [list(m) for m in msgs_list]
+        wrong[5][0] = (wrong[5][0] + 1) % R
+        bits = ps.batch_verify(
+            bad, wrong, vk, params, backend=pybe, mode="batched"
+        )
+        expect = [i not in (3, 5) for i in range(B)]
+        assert bits == expect
+        assert bits == ps.batch_verify(
+            bad, wrong, vk, params, backend=pybe, mode="exact"
+        )
+        assert metrics.get_count("verify_batched_fallbacks") == 1
+        assert metrics.get_count("verify_bisection_depth") >= 1
+
+    def test_single_lane_equivalence(self, params, keypair, valid_batch,
+                                     pybe):
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        assert ps.batch_verify(
+            sigs[:1], msgs_list[:1], vk, params, backend=pybe,
+            mode="batched",
+        ) == [True]
+        forged = [Signature(
+            sigs[0].sigma_1, params.ctx.sig.mul(sigs[0].sigma_2, 3)
+        )]
+        assert ps.batch_verify(
+            forged, msgs_list[:1], vk, params, backend=pybe,
+            mode="batched",
+        ) == [False]
+
+    def test_identity_sigma_lane(self, params, keypair, valid_batch,
+                                 pybe):
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        mixed = list(sigs)
+        mixed[2] = Signature(None, None)
+        bits = ps.batch_verify(
+            mixed, msgs_list, vk, params, backend=pybe, mode="batched"
+        )
+        assert bits == [i != 2 for i in range(B)]
+
+    def test_empty_batch(self, params, keypair, pybe):
+        _, vk = keypair
+        assert ps.batch_verify(
+            [], [], vk, params, backend=pybe, mode="batched"
+        ) == []
+
+    def test_mode_validation(self, params, keypair, valid_batch, pybe):
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        with pytest.raises(PSError):
+            ps.batch_verify(
+                sigs, msgs_list, vk, params, backend=pybe, mode="bogus"
+            )
+        with pytest.raises(PSError):
+            ps.batch_verify(sigs, msgs_list, vk, params, mode="batched")
+
+
+class TestBatchedShowVerify:
+    @pytest.fixture(scope="class")
+    def shows(self, params, keypair, valid_batch):
+        _, vk = keypair
+        sigs, msgs_list = valid_batch
+        proofs, challenges, revealed = [], [], []
+        for s, m in zip(sigs, msgs_list):
+            p, c, rv = show(s, vk, params, m, [0])
+            proofs.append(p)
+            challenges.append(c)
+            revealed.append(rv)
+        return proofs, challenges, revealed
+
+    def test_all_valid_bit_identical_to_exact(self, params, keypair,
+                                              shows, pybe):
+        _, vk = keypair
+        proofs, challenges, revealed = shows
+        exact = batch_show_verify(
+            proofs, vk, params, revealed, challenges=challenges,
+            backend=pybe, mode="exact",
+        )
+        batched = batch_show_verify(
+            proofs, vk, params, revealed, challenges=challenges,
+            backend=pybe, mode="batched",
+        )
+        assert batched == exact == [True] * B
+
+    def test_tampered_lane_attributed(self, params, keypair, shows,
+                                      pybe):
+        _, vk = keypair
+        proofs, challenges, revealed = shows
+        rv = [dict(r) for r in revealed]
+        rv[4][0] = (rv[4][0] + 1) % R
+        bits = batch_show_verify(
+            proofs, vk, params, rv, challenges=challenges,
+            backend=pybe, mode="batched",
+        )
+        assert bits == [i != 4 for i in range(B)]
+        assert metrics.get_count("verify_batched_fallbacks") == 1
+
+    def test_dead_lane_fails_alone(self, params, keypair, shows, pybe):
+        # identity sigma': the lane is excluded from the fold and fails
+        # via its own schnorr/dead bit — the rest of the batch passes
+        # the combined pairing check without a bisection ladder
+        from coconut_tpu.ps import PoKOfSignatureProof
+
+        _, vk = keypair
+        proofs, challenges, revealed = shows
+        dead = list(proofs)
+        p0 = proofs[1]
+        dead[1] = PoKOfSignatureProof(
+            None, None, p0.J, p0.proof_vc, p0.revealed_msg_indices
+        )
+        bits = batch_show_verify(
+            dead, vk, params, revealed, challenges=challenges,
+            backend=pybe, mode="batched",
+        )
+        assert bits == [i != 1 for i in range(B)]
+        assert metrics.get_count("verify_batched_fallbacks") == 0
+
+    def test_mode_validation(self, params, keypair, shows):
+        _, vk = keypair
+        proofs, challenges, revealed = shows
+        with pytest.raises(PSError):
+            batch_show_verify(
+                proofs, vk, params, revealed, challenges=challenges,
+                mode="batched",
+            )
+
+
+# --- the serve-layer "batched" mode -----------------------------------------
+
+
+def _cred(ok=True):
+    return SimpleNamespace(sigma_1=1, sigma_2=1, ok=ok)
+
+
+def _lane_bit(s):
+    return s.sigma_1 is not None and bool(getattr(s, "ok", False))
+
+
+class StubCombined:
+    """Stub backend exposing ONLY the combined (RLC) seam plus the
+    per-credential reference path the bisector's leaf probes ride."""
+
+    def __init__(self):
+        self.combined_calls = 0
+
+    def batch_verify_combined(self, sigs, msgs, vk, params, rs=None,
+                              epoch=None):
+        self.combined_calls += 1
+        return all(_lane_bit(s) for s in sigs)
+
+
+def _service(backend, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_wait_ms", 2.0)
+    return CredentialService(backend, None, None, **kw)
+
+
+class TestServeBatchedMode:
+    def test_demux_invariant_one_forged_one_dead_letter(self, tmp_path):
+        dlq = str(tmp_path / "batched_dead.jsonl")
+        be = StubCombined()
+        svc = _service(be, mode="batched", dead_letter_path=dlq).start()
+        futs = [svc.submit(_cred(ok=(i != 2)), [i]) for i in range(4)]
+        assert svc.drain(timeout=10.0)
+        assert [f.result(0) for f in futs] == [True, True, False, True]
+        records = DeadLetterLog.read(dlq)
+        assert len(records) == 1
+        assert records[0]["batch"] == 0 and records[0]["credential"] == 2
+        assert records[0]["program"] == "verify"
+        assert metrics.get_count("dead_letters") == 1
+        assert be.combined_calls >= 2  # the batch + bisection probes
+
+    def test_all_valid_single_combined_check(self, tmp_path):
+        dlq = str(tmp_path / "batched_clean.jsonl")
+        be = StubCombined()
+        with _service(be, mode="batched", dead_letter_path=dlq) as svc:
+            futs = [svc.submit(_cred(), [i]) for i in range(4)]
+        assert all(f.result(5.0) for f in futs)
+        assert DeadLetterLog.read(dlq) == []
+        assert be.combined_calls == 1
+
+    def test_jit_shape_key_pow2_bucketed(self):
+        be = StubCombined()
+        svc = _service(be, mode="batched", pad_partial=False).start()
+        try:
+            futs = [svc.submit(_cred(), [i]) for i in range(4)]
+            assert all(f.result(5.0) for f in futs)
+            futs = [svc.submit(_cred(), [i]) for i in range(3)]
+            assert all(f.result(5.0) for f in futs)
+            # 3 and 4 lanes share the pow2-4 bucket: ONE jit shape
+            assert metrics.get_count("serve_jit_shapes") == 1
+        finally:
+            svc.shutdown()
+
+    def test_env_default_mode(self, monkeypatch):
+        monkeypatch.setenv("COCONUT_BATCH_VERIFY", "1")
+        with _service(StubCombined()) as svc:
+            assert svc.mode == "batched"
+        monkeypatch.delenv("COCONUT_BATCH_VERIFY")
+        stub = StubCombined()
+        stub.batch_verify = lambda s, m, vk, p: [_lane_bit(x) for x in s]
+        with _service(stub) as svc:
+            assert svc.mode == "per_credential"
+
+    def test_keychain_refused(self):
+        from coconut_tpu.serve.service import VerifyProgram
+
+        with pytest.raises(ValueError):
+            VerifyProgram(
+                StubCombined(), None, None, "batched", 4, 2.0, 16,
+                False, None, None, None, keychain=object(),
+            )
+
+    def test_unknown_mode_refused(self):
+        with pytest.raises(ValueError):
+            _service(StubCombined(), mode="combined")

@@ -146,11 +146,14 @@ def test_retryable_error_hierarchy():
 # --- online/offline parity -------------------------------------------------
 
 
-def test_show_verify_parity_ragged_and_padded(world, creds):
+@pytest.mark.parametrize("showv_mode", ["exact", "batched"])
+def test_show_verify_parity_ragged_and_padded(world, creds, showv_mode):
     """Five proofs through a max_batch=4 engine lane — one full batch
     plus a ragged final batch padded clone-first-proof — must produce
     verdict bits identical to ONE direct ps.batch_show_verify call,
-    including a tampered (False) lane."""
+    including a tampered (False) lane. Runs in both show-verify modes:
+    the PR-16 batched (RLC combined pairing) lane must match the exact
+    path bit-for-bit through the same clone-first padding."""
     sigs = [s for s, _ in creds]
     msgs = [m for _, m in creds]
     proofs, challenges, revealed_list = pok_sig.batch_show(
@@ -171,7 +174,9 @@ def test_show_verify_parity_ragged_and_padded(world, creds):
     assert list(direct) == [True, True, False, True, True]
 
     metrics.reset()
-    eng = _engine(world, max_batch=4, max_wait_ms=10.0)
+    eng = _engine(
+        world, max_batch=4, max_wait_ms=10.0, showv_mode=showv_mode
+    )
     try:
         futs = [
             eng.submit_show_verify(p, rev, chal)

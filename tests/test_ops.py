@@ -272,3 +272,74 @@ class TestSerialize:
             x += 1
         with pytest.raises(DeserializationError):
             ser.g1_from_bytes(ser.fp_to_bytes(cand[0]) + ser.fp_to_bytes(cand[1]))
+
+
+class TestPadLaneSemantics:
+    """The pad-lane contract the PR-16 RLC batch verifier leans on
+    (tpu/pairing.multi_miller_loop docstring): a pair with valid=0 — at
+    the spec level, a pair containing an identity (None) point —
+    contributes EXACTLY the GT identity to the product, so pad lanes
+    never change a batch's verdict no matter where they sit."""
+
+    def _good_bad_pad(self):
+        b = rand_fr()
+        good = [
+            (G1_GEN, g2.mul(G2_GEN, b)),
+            (g1.neg(g1.mul(G1_GEN, b)), G2_GEN),
+        ]
+        bad = [(G1_GEN, g2.mul(G2_GEN, b)), (g1.neg(G1_GEN), G2_GEN)]
+        pad = (None, G2_GEN)
+        return good, bad, pad
+
+    @pytest.fixture(scope="class")
+    def jaxbe(self):
+        try:
+            import jax  # noqa: F401
+
+            from coconut_tpu.tpu import backend as _jb  # noqa: F401
+        except ImportError:
+            pytest.skip("jax backend unavailable")
+        from coconut_tpu.backend import get_backend
+
+        return get_backend("jax")
+
+    def test_all_pad_row_is_identity(self, jaxbe):
+        # every lane valid=0: the empty product, i.e. GT identity -> True
+        _, _, pad = self._good_bad_pad()
+        assert pr.pairing_check([pad, pad])
+        got = jaxbe.pairing_product_is_one([[pad, pad], [pad, pad]])
+        assert got == [True, True]
+
+    def test_ragged_final_batch_pad(self, jaxbe):
+        # a short final row padded out with None pairs keeps its
+        # unpadded verdict — both polarities
+        good, bad, pad = self._good_bad_pad()
+        rows = [good + [pad, pad], bad + [pad, pad]]
+        assert pr.pairing_check(rows[0]) and not pr.pairing_check(rows[1])
+        got = jaxbe.pairing_product_is_one(rows)
+        assert got == [True, False]
+
+    def test_interleaved_pad_lanes(self, jaxbe):
+        # pad position is irrelevant: leading, interleaved, trailing
+        good, bad, pad = self._good_bad_pad()
+        layouts = [
+            [pad] + good + [pad],
+            [good[0], pad, good[1], pad],
+            [pad, bad[0], pad, bad[1]],
+        ]
+        expect = [True, True, False]
+        assert [pr.pairing_check(r) for r in layouts] == expect
+        assert jaxbe.pairing_product_is_one(layouts) == expect
+
+    def test_pad_coordinates_are_inert(self, jaxbe):
+        # a valid=0 lane's PARTNER coordinates may be arbitrary curve
+        # points without perturbing the product (the Miller lines are
+        # masked per step, not post-hoc)
+        good, _, _ = self._good_bad_pad()
+        junk1 = g1.mul(G1_GEN, rand_fr())
+        junk2 = g2.mul(G2_GEN, rand_fr())
+        rows = [
+            good + [(None, junk2), (junk1, None)],
+            good + [(None, G2_GEN), (G1_GEN, None)],
+        ]
+        assert jaxbe.pairing_product_is_one(rows) == [True, True]
