@@ -26,6 +26,9 @@ Also measured (BASELINE.md configs):
   batchverify lane: RLC-combined vs exact verify/show-verify       [--batchverify]
     (ISSUE 16 — B in BENCH_BATCHVERIFY_SIZES, crossover point,
     <= 2 final exps per combined batch; BENCH_BATCHVERIFY=0 skips)
+  state lane: show-verify goodput bare vs WAL-backed nullifiers    [--state]
+    (ISSUE 17 — group-commit fsync per batch, ratio >=
+    BENCH_STATE_MIN_RATIO (0.85); BENCH_STATE=0 skips)
 
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
@@ -526,6 +529,122 @@ def bench_gateway(ge, params, vk, sigs, msgs_list, extras, backend_name):
         "rpc": rpc,
     }
     return rpc["goodput_per_s"]
+
+
+def bench_state(ge, params, extras, backend_name):
+    """Durable-state lane (--state, ISSUE 17): the WAL tax. The same
+    show-verify traffic is driven twice through a ProtocolEngine —
+    first bare, then with a StateStore-backed nullifier guard (device
+    membership probe + group-commit WAL append per batch) — and the
+    goodput ratio must stay >= BENCH_STATE_MIN_RATIO (default 0.85).
+    Every show is a FRESH re-randomization of one credential, so every
+    lane commits a new nullifier: the durable pass pays the full
+    journal cost, one fsync per engine batch (group commit), never one
+    per lane — the artifact embeds wal_appends vs wal_fsyncs to prove
+    the policy. Knobs: BENCH_STATE_SHOWS (default 64),
+    BENCH_STATE_MAX_BATCH (default 4); BENCH_STATE=0 skips."""
+    import tempfile
+
+    from coconut_tpu import metrics
+    from coconut_tpu.elgamal import elgamal_keygen
+    from coconut_tpu.engine import ProtocolEngine
+    from coconut_tpu.keygen import trusted_party_SSS_keygen
+    from coconut_tpu.sss import rand_fr
+    from coconut_tpu.state import StateStore
+
+    n_shows = int(os.environ.get("BENCH_STATE_SHOWS", "64"))
+    max_batch = int(os.environ.get("BENCH_STATE_MAX_BATCH", "4"))
+    min_ratio = float(os.environ.get("BENCH_STATE_MIN_RATIO", "0.85"))
+
+    _, _, signers = trusted_party_SSS_keygen(2, 3, params)
+    revealed = list(range(2, ge.MSG_COUNT))
+    msgs = [rand_fr() for _ in range(ge.MSG_COUNT)]
+    esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+
+    def _run_pass(store):
+        """One timed show-verify pass; returns (goodput, commits)."""
+        engine = ProtocolEngine(
+            signers, params, 2,
+            count_hidden=2, revealed_msg_indices=revealed,
+            backend=backend_name, max_batch=max_batch,
+            state_store=store,
+        )
+        with engine:
+            req, _ = engine.submit_prepare(msgs, epk).result(600.0)
+            cred = engine.submit_mint(req, msgs, esk).result(600.0)
+            # each lane shows a FRESH re-randomization: distinct
+            # nullifiers, so the durable pass commits on every lane
+            # (+1 warm show outside the timed window)
+            shows = [
+                engine.submit_show_prove(cred, msgs).result(600.0)
+                for _ in range(n_shows + 1)
+            ]
+            proof, chal, rev = shows[0]
+            assert engine.submit_show_verify(proof, rev, chal).result(600.0)
+            c0 = metrics.get_count("nullifier_commits")
+            t0 = time.time()
+            futs = [
+                engine.submit_show_verify(p, r, c)
+                for p, c, r in shows[1:]
+            ]
+            ok = sum(1 for f in futs if f.result(600.0) is True)
+            dt = time.time() - t0
+            assert ok == n_shows, (
+                "state lane: %d of %d fresh shows verified" % (ok, n_shows)
+            )
+        return n_shows / dt, metrics.get_count("nullifier_commits") - c0
+
+    goodput_bare, _ = _run_pass(None)
+    wal_appends0 = metrics.get_count("wal_appends")
+    wal_fsyncs0 = metrics.get_count("wal_fsyncs")
+    root = tempfile.mkdtemp(prefix="bench-state-")
+    try:
+        store = StateStore(root, replica_id="bench-r0")
+        goodput_store, commits = _run_pass(store)
+        store.close()
+    finally:
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+    wal_appends = metrics.get_count("wal_appends") - wal_appends0
+    wal_fsyncs = metrics.get_count("wal_fsyncs") - wal_fsyncs0
+
+    assert commits == n_shows, (
+        "durable pass committed %d nullifiers for %d timed shows"
+        % (commits, n_shows)
+    )
+    # THE fsync policy: group commit per engine batch, never per lane —
+    # with max_batch-wide batches the sync count stays well under the
+    # lane count (each batch is one append_many = one fsync)
+    assert wal_fsyncs <= (n_shows + 1 + max_batch - 1) // max_batch + n_shows // 2, (
+        "fsync count %d looks per-lane, not per-batch (%d lanes, "
+        "max_batch=%d)" % (wal_fsyncs, n_shows + 1, max_batch)
+    )
+    assert wal_fsyncs < wal_appends or n_shows < max_batch, (
+        "group commit never amortized: %d fsyncs for %d appends"
+        % (wal_fsyncs, wal_appends)
+    )
+    ratio = (
+        round(goodput_store / goodput_bare, 4) if goodput_bare else None
+    )
+    assert ratio is not None and ratio >= min_ratio, (
+        "durable nullifier set costs too much: with-store/bare goodput "
+        "ratio %r < %r (bare=%r store=%r)"
+        % (ratio, min_ratio, goodput_bare, goodput_store)
+    )
+    extras["state"] = {
+        "fsync_policy": "group_commit_per_batch",
+        "shows": n_shows,
+        "max_batch": max_batch,
+        "min_ratio": min_ratio,
+        "goodput_bare_per_s": round(goodput_bare, 2),
+        "goodput_store_per_s": round(goodput_store, 2),
+        "goodput_ratio": ratio,
+        "nullifier_commits": commits,
+        "wal_appends": wal_appends,
+        "wal_fsyncs": wal_fsyncs,
+    }
+    return ratio
 
 
 def bench_lifecycle(extras):
@@ -1139,6 +1258,10 @@ def main():
         "--batchverify" in sys.argv[1:]
         and os.environ.get("BENCH_BATCHVERIFY", "1") == "1"
     )
+    state_flag = (
+        "--state" in sys.argv[1:]
+        and os.environ.get("BENCH_STATE", "1") == "1"
+    )
     # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
     # offline lanes so the CI online smokes don't pay for them
     offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
@@ -1149,6 +1272,7 @@ def main():
         or lifecycle_flag
         or keylife_flag
         or batchverify_flag
+        or state_flag
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1229,6 +1353,12 @@ def main():
         if value is None:
             value = bv_speedup
             metric, unit = "batchverify_speedup_at_max_batch", "x"
+
+    if state_flag:
+        state_ratio = bench_state(ge, params, extras, backend_name)
+        if value is None:
+            value = state_ratio
+            metric, unit = "state_goodput_ratio", "x"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

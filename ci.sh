@@ -48,12 +48,14 @@ grep -q '"batch": 1' "$DLQ"
 grep -q '"credential": 2' "$DLQ"
 grep -q '"reason"' "$DLQ"
 grep -q '"attempts"' "$DLQ"
-# schema v3: every line carries trace join keys (null with tracing off)
-# and the engine program name (null on the offline stream path)
-grep -q '"schema": 3' "$DLQ"
+# schema v4: every line carries trace join keys (null with tracing off),
+# the engine program name (null on the offline stream path), and the
+# nullifier digest (null off the show-verify double-spend path)
+grep -q '"schema": 4' "$DLQ"
 grep -q '"trace_id"' "$DLQ"
 grep -q '"span_id"' "$DLQ"
 grep -q '"program"' "$DLQ"
+grep -q '"nullifier"' "$DLQ"
 echo "dead-letter schema: ok"
 
 echo "== serve lane (dynamic batching / admission control / loadgen) =="
@@ -391,6 +393,47 @@ else
   echo "batchverify bench smoke: skipped (BENCH_BATCHVERIFY=0)"
 fi
 
+echo "== state lane (durable WAL / replicated nullifiers / kill-the-witness) =="
+# the marker suite: WAL framing + torn-tail truncation (counted exactly
+# once), the five-point crash enumeration (pre-append / mid-record /
+# post-append-pre-fsync / mid-snapshot / mid-compaction -> prefix-
+# consistent replay), snapshot+replay StateStore with LWW anti-entropy,
+# nullifier derivation / device-vs-host probe parity / check-and-set
+# commit, the typed DoubleSpendError through engine + wire, and the
+# deterministic loopback kill-the-witness drill
+python -m pytest tests/test_state.py -m state -q
+# end-to-end acceptance smoke (ISSUE 17): a REAL 3-replica TCP fleet
+# with per-replica WALs and beacon-driven anti-entropy — witness a show,
+# SIGKILL-equivalent the witnessing replica, prove both survivors AND
+# the WAL-replaying restarted witness still reject the replayed
+# nullifier while a fresh re-randomized show stays accepted.
+JAX_PLATFORMS=cpu python probes/probe_nullifier.py
+# bench smoke: show-verify goodput bare vs WAL-backed nullifier set,
+# asserted from the JSON artifact — the ISSUE 17 floor is >= 0.85x
+# goodput with the group-commit-per-batch fsync policy visible as
+# wal_fsyncs well under wal_appends. BENCH_STATE=0 skips the lane.
+if [ "${BENCH_STATE:-1}" = "1" ]; then
+  STATE_JSON=$(mktemp -d)/state.json
+  BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=8 BENCH_CHAOS=0 \
+    BENCH_STATE_SHOWS=32 JAX_PLATFORMS=cpu \
+    python bench.py --state > "$STATE_JSON"
+  STATE_JSON_PATH="$STATE_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["STATE_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["state"]
+assert report["fsync_policy"] == "group_commit_per_batch", report
+assert report["goodput_ratio"] >= report["min_ratio"], report
+assert report["wal_fsyncs"] < report["wal_appends"], report
+assert report["nullifier_commits"] == report["shows"], report
+print("state bench smoke: ok (ratio %.2fx, %d commits in %d fsyncs)"
+      % (report["goodput_ratio"], report["nullifier_commits"],
+         report["wal_fsyncs"]))
+EOF
+else
+  echo "state bench smoke: skipped (BENCH_STATE=0)"
+fi
+
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q
 # end-to-end acceptance smoke on the REAL service (CPU, stub backend):
@@ -428,7 +471,7 @@ with svc:
     verdicts = [f.result(30.0) for f in futs]
 assert verdicts == [True, True, False, True], verdicts
 (rec,) = DeadLetterLog.read(dlq)
-assert rec["schema"] == 3 and rec["trace_id"] == futs[2].trace_id, rec
+assert rec["schema"] == 4 and rec["trace_id"] == futs[2].trace_id, rec
 assert rec["program"] == "verify", rec
 tree = otrace.get_tracer().spans_for(futs[2].trace_id)
 names = {s.name for s in tree}

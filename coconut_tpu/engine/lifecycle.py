@@ -55,7 +55,6 @@ counters "lifecycle_warmed_shapes", "lifecycle_warm_skipped",
 """
 
 import json
-import os
 import threading
 import time
 
@@ -154,8 +153,11 @@ class ShapeManifest:
         }
 
     def save(self, path):
-        """Atomic write (tmp + os.replace): a crash mid-save leaves the
-        previous manifest intact, never a truncated one. Shapes that
+        """Crash-atomic write (state/atomic.py: tmp + fsync +
+        os.replace + dir fsync): a crash mid-save leaves the previous
+        manifest intact, never a truncated one — and unlike the
+        pre-PR-17 hand-rolled copy, the bytes are fsync'd before the
+        rename so the manifest survives a power cut too. Shapes that
         JSON cannot express are dropped with a counter — a partial
         manifest still warms everything it names."""
         entries = []
@@ -176,15 +178,9 @@ class ShapeManifest:
             "engine": self.engine_name,
             "shapes": entries,
         }
-        path = str(path)
-        parent = os.path.dirname(path)
-        if parent:
-            os.makedirs(parent, exist_ok=True)
-        tmp = "%s.tmp.%d" % (path, os.getpid())
-        with open(tmp, "w") as fh:
-            json.dump(doc, fh, sort_keys=True)
-        os.replace(tmp, path)
-        return path
+        from ..state.atomic import replace_json
+
+        return replace_json(str(path), doc, sort_keys=True)
 
     @classmethod
     def load(cls, path):

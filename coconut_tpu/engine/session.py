@@ -78,6 +78,8 @@ class ProtocolEngine(ExecutionEngine):
         max_redispatch=None,
         keychain=None,
         showv_mode=None,
+        state_store=None,
+        dead_letter_path=None,
     ):
         from ..backend import get_backend
         from ..batchverify import env_batched_default
@@ -115,6 +117,24 @@ class ProtocolEngine(ExecutionEngine):
         #: resolve their verkey by mint epoch on every phase; None = the
         #: historical single-verkey engine
         self.keychain = keychain
+        #: state.StateStore (PR 17): the replica's durable state plane.
+        #: When set, show-verify grows the replicated nullifier/double-
+        #: spend subsystem: a NullifierGuard over the store (device
+        #: membership probe + WAL-group-committed check-and-set) and a
+        #: store-indexed dead-letter log. The beacon (net/rpc.py)
+        #: piggybacks `state_store.marks()` for anti-entropy.
+        self.state_store = state_store
+        self.nullifiers = None
+        self.dead_letters = None
+        if state_store is not None:
+            from ..faults import DeadLetterLog
+            from ..state.nullifier import NullifierGuard
+
+            self.nullifiers = NullifierGuard(state_store)
+            if dead_letter_path is not None:
+                self.dead_letters = DeadLetterLog(
+                    dead_letter_path, store=state_store
+                )
 
         common = dict(
             max_batch=max_batch, max_wait_ms=max_wait_ms,
@@ -145,7 +165,9 @@ class ProtocolEngine(ExecutionEngine):
         )
         self._showv = ShowVerifyProgram(
             vk, params, backend=backend, pad_partial=pad_partial,
-            keychain=keychain, mode=showv_mode, **common
+            keychain=keychain, mode=showv_mode,
+            nullifiers=self.nullifiers, dead_letters=self.dead_letters,
+            **common
         )
         for prog in (self._prepare, self._prove, self._showv):
             self.register(prog)

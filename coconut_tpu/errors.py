@@ -11,6 +11,7 @@ float >= 0 — constructors normalize None/negative/non-finite hints to 0.0
 so neither local callers nor the wire codec ever defend against None."""
 
 import math
+import re
 
 
 def _finite_retry_after(value):
@@ -356,6 +357,50 @@ class TenantRateLimitError(ServiceRetryableError):
         self.tenant = tenant
 
 
+class DoubleSpendError(CoconutError):
+    """A show-verify lane presented a credential whose nullifier is
+    already in the replicated nullifier set (coconut_tpu/state) — the
+    Coconut paper's e-cash/petition double-spend case. NOT retriable
+    anywhere in the fleet: the nullifier is a deterministic digest of
+    the proof transcript, so replaying the same show against any
+    replica that has the fact (locally witnessed, WAL-replayed, or
+    anti-entropy-replicated) yields the same rejection. Carries the
+    `nullifier` hex digest and the `epoch` it is scoped to. Counted
+    under "nullifier_double_spends"."""
+
+    code = "double_spend"
+
+    # class-level defaults: error_from_wire reconstructs non-retryable
+    # errors via cls.__new__ + CoconutError.__init__, which never runs
+    # this subclass __init__ — attribute reads must still succeed
+    nullifier = None
+    epoch = None
+
+    def __init__(self, nullifier=None, epoch=None):
+        super().__init__(
+            "credential already shown: nullifier %s is spent%s"
+            % (
+                nullifier if nullifier is not None else "<unknown>",
+                "" if epoch is None else " (epoch %d)" % epoch,
+            )
+        )
+        self.nullifier = nullifier
+        self.epoch = epoch
+
+    def _restore_wire_fields(self, message):
+        # the envelope carries only (code, message); the message format
+        # above is part of the wire contract, so the structured fields
+        # survive the round trip — clients match on err.nullifier, not
+        # on message text
+        m = re.search(
+            r"nullifier ([0-9a-f]{64}) is spent(?: \(epoch (\d+)\))?",
+            message,
+        )
+        if m is not None:
+            self.nullifier = m.group(1)
+            self.epoch = None if m.group(2) is None else int(m.group(2))
+
+
 #: the 1:1 code <-> class map the wire error envelope encodes/decodes
 #: through (net/wire.py). Retriable codes reconstruct via `from_wire`
 #: (shared fields only); the rest rebuild with their message.
@@ -377,6 +422,7 @@ WIRE_ERROR_CODES = {
         DkgAbortedError,
         EpochUnknownError,
         EpochRetiredError,
+        DoubleSpendError,
     )
 }
 
@@ -399,6 +445,9 @@ def error_from_wire(code, message, program=None, retry_after_s=0.0):
     CoconutError.__init__(err, message)
     if program is not None:
         err.program = program
+    restore = getattr(err, "_restore_wire_fields", None)
+    if restore is not None:
+        restore(message)
     return err
 
 

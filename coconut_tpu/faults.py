@@ -63,14 +63,17 @@ import os
 import threading
 import time
 
+from . import metrics
 from .errors import TransientBackendError
 from .obs import flight as _flight
 from .obs import trace as otrace
 
 #: dead-letter JSONL schema: v2 added trace_id/span_id (absent -> null);
 #: v3 adds the engine program name (absent -> null) so one shared-pool
-#: dead-letter file stays attributable per phase
-DEAD_LETTER_SCHEMA = 3
+#: dead-letter file stays attributable per phase; v4 adds `nullifier`
+#: (absent -> null) so a show-verify double-spend rejection carries the
+#: replicated-state fact that condemned it (coconut_tpu/state)
+DEAD_LETTER_SCHEMA = 4
 
 
 class InjectedCrash(BaseException):
@@ -79,6 +82,84 @@ class InjectedCrash(BaseException):
     (`except Exception` in _launch/_settle) must NOT catch it — it
     escapes to the executor loop's crash handler, modeling a genuine code
     bug in the dispatch path rather than a batch-level backend fault."""
+
+
+class SimulatedCrash(Exception):
+    """A process kill simulated at a named durability seam (PR 17).
+
+    Raised by `WalChaos.crash(point)` inside the WAL/StateStore write
+    paths. Unlike `InjectedCrash` this IS a plain Exception: the
+    crash-point enumeration harness (tests/test_state.py) catches it at
+    the call site, abandons the store object mid-operation exactly as a
+    SIGKILL would abandon the process, and re-opens the directory to
+    prove replay converges."""
+
+
+class WalChaos:
+    """Deterministic fault schedule for the durable state plane
+    (state/wal.py, state/store.py).
+
+      crash_at       — named crash points ("wal.pre_append",
+                       "wal.post_append", "store.mid_snapshot",
+                       "store.mid_compact") at which `crash()` raises
+                       SimulatedCrash; each fires every time it is hit,
+                       so remove the point (or swap the chaos object)
+                       before re-driving a recovered store;
+      torn_on        — 0-based WAL append indices that write only a
+                       PREFIX of the frame (fsync'd, so the torn bytes
+                       really land on disk) then raise — the
+                       mid-record kill, counted in `torn_writes`;
+      fsync_fail_on  — 0-based WAL fsync indices that raise OSError
+                       instead of syncing (a dying disk).
+
+    All schedules are index-based and deterministic, the same
+    discipline as FaultyBackend's dispatch schedules."""
+
+    def __init__(self, crash_at=(), torn_on=(), fsync_fail_on=()):
+        self.crash_at = set(crash_at)
+        self.torn_on = set(torn_on)
+        self.fsync_fail_on = set(fsync_fail_on)
+        self.torn_writes = 0
+        self.crashes = 0
+        self._fsyncs = 0
+
+    def crash(self, point):
+        if point in self.crash_at:
+            self.crashes += 1
+            raise SimulatedCrash("injected crash at %s" % point)
+
+    def fsync_fails(self):
+        idx = self._fsyncs
+        self._fsyncs += 1
+        return idx in self.fsync_fail_on
+
+    def error(self, message):
+        return SimulatedCrash(message)
+
+
+class ReplicationChaos:
+    """Replication-gap injection for the anti-entropy path
+    (state/replicate.py): `drop_pairs` is a set of (peer_id, keyspace)
+    pairs — with keyspace None matching every keyspace — whose pulls
+    are swallowed (counted under "state_antientropy_dropped"). Dropped
+    pulls retry on a later step, so clearing the schedule demonstrates
+    convergence-after-heal."""
+
+    def __init__(self, drop_pairs=()):
+        self.drop_pairs = set(drop_pairs)
+        self.dropped = 0
+
+    def drop(self, peer, keyspace):
+        hit = (peer, keyspace) in self.drop_pairs or (
+            peer,
+            None,
+        ) in self.drop_pairs
+        if hit:
+            self.dropped += 1
+        return hit
+
+    def heal(self):
+        self.drop_pairs.clear()
 
 # the verify entry points verify_stream._dispatchers probes for; faults are
 # injected only on these, everything else delegates untouched
@@ -443,19 +524,30 @@ class ChaosSchedule:
 class DeadLetterLog:
     """Append-only JSONL sink for credentials the stream could not accept.
 
-    One object per line, keys sorted for grep-ability (schema v2):
-      {"attempts": [...], "batch": int, "credential": int, "reason": str,
-       "schema": 2, "span_id": int|null, "trace_id": str|null}
+    One object per line, keys sorted for grep-ability (schema v4):
+      {"attempts": [...], "batch": int, "credential": int,
+       "nullifier": str|null, "reason": str, "schema": 4,
+       "span_id": int|null, "trace_id": str|null}
     where `credential` is the index WITHIN the batch, `attempts` is the
-    batch's retry attempt history (retry.note_attempt records), and
+    batch's retry attempt history (retry.note_attempt records),
     trace_id/span_id join the line to its request's span tree (null with
-    tracing disabled).
+    tracing disabled), and `nullifier` is the spent-nullifier hex digest
+    on show-verify double-spend rejections (null everywhere else).
 
     Disk-bounded: before an append that would cross `max_bytes` or
     `max_records`, the file rotates aside (`<path>.1` newest ..
     `<path>.<keep>` oldest, via obs/flight.rotate_if_needed — the same
     cap discipline the flight-recorder sidecar uses). `read()` reads ONE
-    file; pass the rotated names explicitly to walk history."""
+    file; pass the rotated names explicitly to walk history.
+
+    Durable-state ride-along (PR 17): given a `store` (state/store.py
+    StateStore), every append is also indexed into its "deadletter"
+    keyspace — key `<batch>/<credential>/<n>` -> the record — so the
+    dead-letter index survives restarts via WAL replay and replicates
+    with the rest of the state plane. The JSONL file remains the
+    grep-able source of truth; the store index is lazy-durability
+    (fsync=False: losing the last few index entries on a crash is
+    acceptable, the JSONL line is what operators act on)."""
 
     def __init__(
         self,
@@ -463,11 +555,14 @@ class DeadLetterLog:
         max_bytes=_flight.FLIGHT_MAX_BYTES,
         max_records=None,
         keep=_flight.FLIGHT_KEEP,
+        store=None,
     ):
         self.path = path
         self.max_bytes = max_bytes
         self.max_records = max_records
         self.keep = keep
+        self.store = store
+        self._indexed = 0  # store-index sequence (uniquifies keys)
         self._records = None  # lazy line count of the live file
 
     def append(
@@ -479,12 +574,14 @@ class DeadLetterLog:
         trace_id=None,
         span_id=None,
         program=None,
+        nullifier=None,
     ):
         """Append one culprit record. trace_id/span_id default to the
         ACTIVE span's (the bisection span, within the batch trace) when
         tracing is enabled; the serve path overrides trace_id with the
         culprit request's own. `program` names the engine program whose
-        batch produced the culprit (schema v3). Triggers a
+        batch produced the culprit (schema v3); `nullifier` is the spent
+        digest on double-spend rejections (schema v4). Triggers a
         flight-recorder dump for the recorded trace."""
         cur = otrace.current()
         if cur is not None:
@@ -501,6 +598,7 @@ class DeadLetterLog:
             "trace_id": trace_id,
             "span_id": span_id,
             "program": program,
+            "nullifier": nullifier,
         }
         if self._records is None:
             self._records = (
@@ -519,6 +617,21 @@ class DeadLetterLog:
         with open(self.path, "a") as f:
             f.write(json.dumps(rec, sort_keys=True) + "\n")
         self._records += 1
+        if self.store is not None:
+            self._indexed += 1
+            try:
+                self.store.put(
+                    "deadletter",
+                    "%d/%d/%d"
+                    % (rec["batch"], rec["credential"], self._indexed),
+                    rec,
+                    fsync=False,
+                )
+            except Exception:
+                # the JSONL line already landed: a failing durable
+                # index must not turn a dead-letter append into a
+                # second failure
+                metrics.count("dead_letter_index_errors")
         _flight.record(
             self.path,
             "dead_letter",
@@ -536,7 +649,8 @@ class DeadLetterLog:
         """All records in `path` (empty list if it does not exist).
         Older records are normalized on read: absent trace fields become
         null (pre-v2), absent program becomes null (pre-v3), absent
-        schema becomes 1 — readers never need per-version key checks."""
+        nullifier becomes null (pre-v4), absent schema becomes 1 —
+        readers never need per-version key checks."""
         if not os.path.exists(path):
             return []
         with open(path) as f:
@@ -546,4 +660,5 @@ class DeadLetterLog:
             rec.setdefault("trace_id", None)
             rec.setdefault("span_id", None)
             rec.setdefault("program", None)
+            rec.setdefault("nullifier", None)
         return recs

@@ -122,7 +122,7 @@ class EpochRegistry:
     resolver. Thread-safe: mint fan-outs pin/unpin from authority
     threads while the lifecycle manager activates from its own."""
 
-    def __init__(self, window=3):
+    def __init__(self, window=3, store=None):
         if window < 1:
             raise ValueError("window must be >= 1 (got %r)" % (window,))
         self.window = window
@@ -131,8 +131,30 @@ class EpochRegistry:
         self._active = None  # epoch id
         self._max_registered = 0
         self._retired = set()  # epoch ids retired out of the window
+        #: state.StateStore (PR 17): the registry journals its state-
+        #: machine transitions into the "epoch" keyspace. Key material
+        #: is deliberately NOT journaled (shares cannot round-trip
+        #: through a replicated log); what survives a restart is the
+        #: METADATA — which epoch ids exist and which are retired — so
+        #: a restarted replica keeps refusing retired-epoch credentials
+        #: and never re-issues an already-used epoch id, even before
+        #: its keysets are re-installed by the lifecycle manager.
+        self._store = store
+        if store is not None:
+            for key in store.keys("epoch"):
+                epoch = int(key)
+                self._max_registered = max(self._max_registered, epoch)
+                rec = store.get("epoch", key)
+                if rec and rec.get("event") == "retired":
+                    self._retired.add(epoch)
         metrics.set_gauge("keylife_active_epoch", 0)
         metrics.set_gauge("keylife_live_epochs", 0)
+
+    def _journal_locked(self, epoch, event):
+        if self._store is not None:
+            self._store.put(
+                "epoch", str(epoch), {"event": event}, epoch=epoch
+            )
 
     # -- registration / activation (lifecycle-manager side) ------------------
 
@@ -151,6 +173,7 @@ class EpochRegistry:
                 )
             self._entries[keyset.epoch] = _Entry(keyset)
             self._max_registered = keyset.epoch
+            self._journal_locked(keyset.epoch, "registered")
             self._publish_locked()
 
     def activate(self, epoch):
@@ -170,6 +193,7 @@ class EpochRegistry:
             entry.state = ACTIVE
             self._active = epoch
             metrics.count("keylife_activations")
+            self._journal_locked(epoch, "active")
             self._enforce_window_locked()
             self._publish_locked()
 
@@ -301,6 +325,7 @@ class EpochRegistry:
                 break
             del self._entries[victim]
             self._retired.add(victim)
+            self._journal_locked(victim, "retired")
             metrics.count("keylife_retirements")
 
     def _publish_locked(self):

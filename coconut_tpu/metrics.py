@@ -115,6 +115,35 @@ over), and per-placement-state "gateway_placed_<state>" — the
 rolling-restart drill asserts "gateway_placed_warming" and
 "gateway_placed_draining" stay zero.
 
+The DURABLE STATE plane (coconut_tpu/state/, PR 17) reports the
+journal: "wal_appends" (records framed into a WAL) vs "wal_fsyncs"
+(fdatasync calls — the gap between the two IS the group-commit
+amortization, one sync per engine batch rather than per lane),
+"wal_torn_tails" (torn trailing frames truncated on open — exactly
+once per torn crash), "wal_replayed_records" (records re-applied from
+segments on open), "wal_segments_rotated" (bounded-rotation events);
+the store: "state_records_applied" (in-memory applies, local + remote),
+"state_snapshots" / "state_snapshot_loads" / "state_snapshot_corrupt"
+(a CRC-failed snapshot is quarantined `.corrupt` and the store rebuilds
+from the WAL — degrade, never trust), "state_compactions"
+(snapshot+WAL-truncate cycles); anti-entropy: "state_antientropy_pulls"
+(gap pages pulled from peers), "state_antientropy_dropped" (pulls
+suppressed by injected partition chaos), "state_replicator_errors"
+(pull-loop failures — a dead peer is survivable, another peer or a
+later sweep serves the gap), "gateway_state_pulls" (MSG_STATE_PULL
+requests served — also while DRAINING: state transfer is how facts
+escape a dying replica); and the nullifier set: "nullifier_commits"
+(accepted shows durably journaled BEFORE their futures resolve),
+"nullifier_double_spends" (replays rejected with DoubleSpendError),
+"nullifier_probe_hits" (device-probe pre-verify hits),
+"nullifier_probe_errors" (advisory probe failures — detection degrades
+to commit time, never admits a double-spend), "nullifier_commit_errors"
+(WAL-append failures that turned would-be accepts into
+TransientBackendError: no resolve without durability),
+"gateway_tenant_store_errors" / "dead_letter_index_errors" /
+"dead_letter_errors" (lazy-durability write failures in the adopted
+subsystems, counted and survived).
+
 THREAD SAFETY: the serving layer is the first multi-threaded writer
 (admission happens on client threads while the supervisor thread settles
 batches), so every mutation and `snapshot()` runs under one module lock —

@@ -41,6 +41,8 @@ from .router import ReplicaRouter
 from .tenant import Tenant, TenantTable, TokenBucket
 from .wire import (
     MAX_FRAME_BYTES,
+    MSG_STATE_CHUNK,
+    MSG_STATE_PULL,
     WIRE_VERSION,
     Beacon,
     WireCodec,
@@ -51,6 +53,8 @@ from .wire import (
 __all__ = [
     "WIRE_VERSION",
     "MAX_FRAME_BYTES",
+    "MSG_STATE_PULL",
+    "MSG_STATE_CHUNK",
     "WireCodec",
     "Beacon",
     "encode_frame",

@@ -182,6 +182,17 @@ class HealthDirectory:
                 return ()
             return tuple(getattr(v.beacon, "epochs", ()) or ())
 
+    def state_marks(self, rid):
+        """Per-keyspace state high-water marks `rid` last advertised
+        ((keyspace, origin, seq) triples; wire v3 beacons; () when no
+        beacon has landed or the replica runs no StateStore) — the
+        StateReplicator's gap-detection input (state/replicate.py)."""
+        with self._lock:
+            v = self._views.get(rid)
+            if v is None or v.beacon is None:
+                return ()
+            return tuple(getattr(v.beacon, "state_marks", ()) or ())
+
     def queue_depth(self, rid):
         """Last-beacon queue depth (the least-loaded spill key); unknown
         replicas sort last."""

@@ -27,7 +27,13 @@ so the router's job is purely placement quality, not correctness:
              way. Other typed engine refusals (brownout/overload/
              tenant) are NOT failover triggers: they propagate to the
              caller, whose backoff the retry_after_s hint already
-             guides.
+             guides. DoubleSpendError (PR 17) is likewise TERMINAL:
+             the nullifier is a deterministic digest of the replayed
+             transcript, so every replica with the replicated fact
+             returns the same rejection — failing over a double spend
+             would only probe for a replica the anti-entropy pull has
+             not reached yet, which is exactly the race the drill in
+             probes/probe_nullifier.py proves closed.
 
 Counters: "gateway_routed" / "gateway_affinity_hits" / "gateway_spills"
 / "gateway_failovers" / "gateway_drain_handoffs" / per-placement-state

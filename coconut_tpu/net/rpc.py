@@ -152,6 +152,12 @@ class Replica:
         epochs = (
             tuple(keychain.live_epochs()) if keychain is not None else ()
         )
+        # wire v3: piggyback the durable state plane's per-keyspace
+        # high-water marks — peers compare them with their own store
+        # and anti-entropy-pull any gap (state/replicate.py); an engine
+        # without a StateStore advertises the empty mark set
+        store = getattr(eng, "state_store", None)
+        state_marks = store.marks() if store is not None else ()
         crashed = getattr(eng, "_crashed", None) is not None
         lc_state = (
             self.lifecycle.state if self.lifecycle is not None else None
@@ -181,6 +187,7 @@ class Replica:
             executors=len(executors),
             t=now,
             epochs=epochs,
+            state_marks=state_marks,
         )
 
     # -- request handling ----------------------------------------------------
@@ -223,6 +230,41 @@ class Replica:
                     MSG_BEACON, wire.encode_beacon(self.beacon()), seq=seq
                 )
             )
+            return
+        if msg_type == wire.MSG_STATE_PULL:
+            # anti-entropy page (PR 17): serve replicated state records
+            # from the engine's StateStore per-origin log. Served even
+            # while draining — state transfer is how facts escape a
+            # replica on its way down — but not once closed.
+            if self._closed:
+                metrics.count("gateway_refusals")
+                send(
+                    self._error_frame(
+                        ServiceClosedError("replica closed"), seq
+                    )
+                )
+                return
+            store = getattr(self.engine, "state_store", None)
+            try:
+                ks, origin, after_seq, limit = wire.decode_state_pull(
+                    payload
+                )
+                records = (
+                    store.records_after(ks, origin, after_seq, limit)
+                    if store is not None
+                    else ()
+                )
+                metrics.count("gateway_state_pulls")
+                send(
+                    encode_frame(
+                        wire.MSG_STATE_CHUNK,
+                        wire.encode_state_chunk(records),
+                        seq=seq,
+                    )
+                )
+            except Exception as e:
+                metrics.count("gateway_wire_errors")
+                send(self._error_frame(e, seq))
             return
         program = PROGRAM_OF_REQUEST.get(msg_type)
         if program is None:
@@ -682,6 +724,24 @@ class GatewayClient:
                 "beacon poll answered with 0x%02x" % msg_type
             )
         return wire.decode_beacon(payload)
+
+    def pull_state(self, keyspace, origin, after_seq, limit=512,
+                   timeout=5.0):
+        """Synchronous anti-entropy pull (PR 17): one page of the
+        peer's replicated state records for (keyspace, origin) with
+        seq > after_seq. The StateReplicator's transfer path."""
+        msg_type, payload = self.transport.request(
+            wire.MSG_STATE_PULL,
+            wire.encode_state_pull(keyspace, origin, after_seq, limit),
+            timeout=timeout,
+        )
+        if msg_type == MSG_ERROR:
+            raise wire.decode_error(payload)
+        if msg_type != wire.MSG_STATE_CHUNK:
+            raise DeserializationError(
+                "state pull answered with 0x%02x" % msg_type
+            )
+        return wire.decode_state_chunk(payload)
 
     def close(self):
         self.transport.close()

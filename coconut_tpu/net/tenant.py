@@ -96,10 +96,18 @@ class TenantTable:
     """The gateway's tenant registry + admission gate. Thread-safe: the
     replica server admits from per-connection reader threads."""
 
-    def __init__(self, tenants=(), clock=time.monotonic):
+    def __init__(self, tenants=(), clock=time.monotonic, store=None):
         self.clock = clock
         self._lock = threading.Lock()
         self._by_key = {}
+        #: state.StateStore (PR 17): absolute-quota `used` counters
+        #: persist into the "tenant_quota" keyspace on a LAZY
+        #: durability contract (fsync=False — losing the last few
+        #: increments on a crash under-counts briefly, which is the
+        #: safe direction for admission), so a restarted replica does
+        #: not reset every tenant's quota to zero. Rate buckets are
+        #: deliberately NOT persisted: they refill in seconds.
+        self._store = store
         for t in tenants:
             self.add(t)
 
@@ -108,6 +116,15 @@ class TenantTable:
             if tenant.api_key in self._by_key:
                 raise ValueError(
                     "duplicate API key for tenant %r" % (tenant.tenant_id,)
+                )
+            if self._store is not None:
+                tenant.used = max(
+                    tenant.used,
+                    int(
+                        self._store.get(
+                            "tenant_quota", tenant.tenant_id, 0
+                        )
+                    ),
                 )
             self._by_key[tenant.api_key] = tenant
         return tenant
@@ -144,5 +161,14 @@ class TenantTable:
                     tid, retry_after, program=program
                 )
             tenant.used += 1
+            if self._store is not None and tenant.quota is not None:
+                try:
+                    self._store.put(
+                        "tenant_quota", tid, tenant.used, fsync=False
+                    )
+                except Exception:
+                    # lazy contract: a failing store write must not
+                    # turn an admitted request into a refusal
+                    metrics.count("gateway_tenant_store_errors")
             metrics.count("gateway_tenant_%s_admitted" % tid)
             return tenant

@@ -38,6 +38,7 @@ DeserializationError (mapped to a non-retryable "bad_request" envelope
 by the server) rather than producing a half-parsed request.
 """
 
+import json
 import struct
 
 from ..errors import DeserializationError, error_from_wire
@@ -52,7 +53,11 @@ from ..serve.queue import LANES
 #: requests and the mint response carry a u32 epoch (0 = unpinned, the
 #: pre-lifecycle boot verkey), and beacons advertise the replica's live
 #: epoch window.
-WIRE_VERSION = 2
+#: v3 (PR 17): the durable state plane — beacons additionally piggyback
+#: the replica's per-keyspace state high-water marks (the anti-entropy
+#: trigger), and MSG_STATE_PULL/MSG_STATE_CHUNK page replicated state
+#: records between replicas.
+WIRE_VERSION = 3
 
 MAGIC = 0xC0C7
 
@@ -79,6 +84,10 @@ PROGRAM_OF_RESPONSE = {t: name for name, t in RESPONSE_TYPES.items()}
 
 MSG_BEACON_POLL = 0x20
 MSG_BEACON = 0x60
+#: anti-entropy state pull (PR 17): request one page of replicated
+#: state records from a peer's per-origin log
+MSG_STATE_PULL = 0x21
+MSG_STATE_CHUNK = 0x61
 MSG_ERROR = 0x7F
 
 #: request-header lane codes (serve.queue.LANES order)
@@ -302,7 +311,10 @@ class Beacon:
     brownout flag the router's gossip directory routes by, and — since
     wire v2 — the live key-epoch window (sorted (epoch_id, state) pairs
     from keylife.EpochRegistry.live_epochs()) so routers know which mint
-    epochs each replica can still serve."""
+    epochs each replica can still serve, and — since wire v3 — the
+    durable state plane's per-keyspace high-water marks
+    ((keyspace, origin, seq) triples from StateStore.marks()) that
+    trigger anti-entropy pulls for any replica lagging them."""
 
     __slots__ = (
         "replica_id",
@@ -314,6 +326,7 @@ class Beacon:
         "executors",
         "t",
         "epochs",
+        "state_marks",
     )
 
     def __init__(
@@ -327,6 +340,7 @@ class Beacon:
         executors,
         t,
         epochs=(),
+        state_marks=(),
     ):
         self.replica_id = replica_id
         self.state = state
@@ -337,6 +351,7 @@ class Beacon:
         self.executors = executors
         self.t = t
         self.epochs = tuple(epochs)
+        self.state_marks = tuple(state_marks)
 
     def admissible(self):
         """May the router route NEW sessions here? Mirrors the engine's
@@ -386,6 +401,34 @@ def _read_epoch_window(b, o):
     return tuple(out), o
 
 
+def _pack_state_marks(marks):
+    """u16 count + per-entry (str keyspace, str origin, u32 seq);
+    canonical order is the store's (sorted by keyspace then origin)."""
+    entries = list(marks)
+    if len(entries) > 0xFFFF:
+        raise ValueError("state-mark set too long (%d)" % len(entries))
+    out = [len(entries).to_bytes(2, "big")]
+    for ks, origin, seq in entries:
+        out.append(_pack_str(ks))
+        out.append(_pack_str(origin))
+        out.append(int(seq).to_bytes(4, "big"))
+    return b"".join(out)
+
+
+def _read_state_marks(b, o):
+    if len(b) < o + 2:
+        raise DeserializationError("truncated state marks")
+    n = int.from_bytes(b[o : o + 2], "big")
+    o += 2
+    out = []
+    for _ in range(n):
+        ks, o = _read_str(b, o)
+        origin, o = _read_str(b, o)
+        raw, o = _read_exact(b, o, 4, "state marks")
+        out.append((ks, origin, int.from_bytes(raw, "big")))
+    return tuple(out), o
+
+
 def encode_beacon(beacon):
     return b"".join(
         (
@@ -398,6 +441,9 @@ def encode_beacon(beacon):
             int(beacon.executors).to_bytes(4, "big"),
             _F64.pack(float(beacon.t)),
             _pack_epoch_window(getattr(beacon, "epochs", ()) or ()),
+            _pack_state_marks(
+                getattr(beacon, "state_marks", ()) or ()
+            ),
         )
     )
 
@@ -418,11 +464,97 @@ def decode_beacon(payload):
     raw, o = _read_exact(payload, o, 8, "beacon")
     (t,) = _F64.unpack(raw)
     epochs, o = _read_epoch_window(payload, o)
+    state_marks, o = _read_state_marks(payload, o)
     _done(payload, o, "beacon")
     return Beacon(
         replica_id, state, capacity, depth, brownout, healthy, executors, t,
-        epochs=epochs,
+        epochs=epochs, state_marks=state_marks,
     )
+
+
+# -- anti-entropy state transfer (PR 17) -------------------------------------
+#
+# MSG_STATE_PULL asks a peer for one page of its per-origin state log
+# (state/store.py records_after); MSG_STATE_CHUNK answers with the raw
+# record dicts. Values travel as JSON blobs: the state plane treats
+# them as opaque (LWW metadata — keyspace/origin/seq/epoch — is what
+# the wire frames natively), so new keyspaces need no wire bump.
+
+
+def encode_state_pull(keyspace, origin, after_seq, limit):
+    return b"".join(
+        (
+            _pack_str(keyspace),
+            _pack_str(origin),
+            int(after_seq).to_bytes(4, "big"),
+            int(limit).to_bytes(2, "big"),
+        )
+    )
+
+
+def decode_state_pull(payload):
+    keyspace, o = _read_str(payload, 0)
+    origin, o = _read_str(payload, o)
+    raw, o = _read_exact(payload, o, 4, "state pull")
+    after_seq = int.from_bytes(raw, "big")
+    raw, o = _read_exact(payload, o, 2, "state pull")
+    limit = int.from_bytes(raw, "big")
+    _done(payload, o, "state pull")
+    return keyspace, origin, after_seq, limit
+
+
+def encode_state_chunk(records):
+    """u16 count + per-record (str ks, str key, blob json-value,
+    str origin, u32 seq, u32 epoch (0 = None), u8 tombstone)."""
+    records = list(records)
+    if len(records) > 0xFFFF:
+        raise ValueError("state chunk too long (%d)" % len(records))
+    out = [len(records).to_bytes(2, "big")]
+    for rec in records:
+        out.append(_pack_str(rec["ks"]))
+        out.append(_pack_str(rec["k"]))
+        out.append(
+            _pack_blob(json.dumps(rec["v"], sort_keys=True).encode())
+        )
+        out.append(_pack_str(rec["o"]))
+        out.append(int(rec["s"]).to_bytes(4, "big"))
+        out.append(_pack_epoch(rec["e"]))
+        out.append(bytes([1 if rec["t"] else 0]))
+    return b"".join(out)
+
+
+def decode_state_chunk(payload):
+    if len(payload) < 2:
+        raise DeserializationError("truncated state chunk")
+    n = int.from_bytes(payload[:2], "big")
+    o = 2
+    out = []
+    for _ in range(n):
+        ks, o = _read_str(payload, o)
+        key, o = _read_str(payload, o)
+        blob, o = _read_blob(payload, o)
+        origin, o = _read_str(payload, o)
+        raw, o = _read_exact(payload, o, 4, "state chunk")
+        seq = int.from_bytes(raw, "big")
+        epoch, o = _read_epoch(payload, o)
+        raw, o = _read_exact(payload, o, 1, "state chunk")
+        try:
+            value = json.loads(blob.decode("utf-8"))
+        except (UnicodeDecodeError, ValueError):
+            raise DeserializationError("malformed state-record value")
+        out.append(
+            {
+                "ks": ks,
+                "k": key,
+                "v": value,
+                "o": origin,
+                "s": seq,
+                "e": epoch,
+                "t": int(raw[0] != 0),
+            }
+        )
+    _done(payload, o, "state chunk")
+    return out
 
 
 # -- program request/response codec ------------------------------------------

@@ -296,6 +296,7 @@ class CredentialService(ExecutionEngine):
         watchdog_interval_s=0.25,
         brownout=None,
         max_redispatch=None,
+        state_store=None,
     ):
         from ..backend import get_backend
         from ..errors import TransientBackendError
@@ -334,6 +335,12 @@ class CredentialService(ExecutionEngine):
         self.max_batch = max_batch
         self.max_wait_ms = max_wait_ms
         self.pad_partial = pad_partial and mode == "per_credential"
+        #: state.StateStore (PR 17): a verify-only service carries no
+        #: nullifier guard (double-spend lives on the show-verify lane,
+        #: engine/phases.py), but exposing the store here lets its
+        #: Replica advertise state marks and serve anti-entropy pulls —
+        #: a verify fleet can still host replicated state.
+        self.state_store = state_store
 
         self._fallback_dispatch = (
             _fallback_dispatcher(fallback_backend, mode)

@@ -1,0 +1,62 @@
+"""Durable fleet state plane (PR 17).
+
+`atomic`    — the ONE shared tmp+fsync+os.replace crash-atomic
+              replacement helper (extracted from stream.py and
+              engine/lifecycle.py, reused by everything below).
+`wal`       — per-replica append-only CRC-framed write-ahead log:
+              group-commit fsync, torn-tail truncation, bounded
+              segment rotation.
+`store`     — snapshot+replay StateStore over the WAL: named
+              keyspaces, per-origin monotonic apply indices,
+              last-writer-wins by (epoch, apply-index, origin),
+              compaction = snapshot + WAL reset.
+`replicate` — gossip-piggybacked anti-entropy: beacons carry
+              per-keyspace high-water marks, gaps are pulled and
+              applied idempotently.
+`nullifier` — the first real consumer: the epoch-scoped double-spend
+              set (device-resident batched membership probe + host
+              authoritative WAL-backed check-and-set).
+
+See README "Durable state & double-spend detection" for the record
+format and recovery invariants."""
+
+from .atomic import fsync_dir, replace_file, replace_json
+from .nullifier import (
+    NullifierGuard,
+    build_table,
+    digests_to_limbs,
+    keyspace_of,
+    membership_probe,
+    nullifier_of,
+)
+from .replicate import StateReplicator
+from .store import SNAPSHOT_SCHEMA, StateStore
+from .wal import (
+    DEFAULT_KEEP,
+    DEFAULT_SEGMENT_BYTES,
+    FRAME_HEADER_BYTES,
+    WriteAheadLog,
+    frame_record,
+    scan_frames,
+)
+
+__all__ = [
+    "DEFAULT_KEEP",
+    "DEFAULT_SEGMENT_BYTES",
+    "FRAME_HEADER_BYTES",
+    "NullifierGuard",
+    "SNAPSHOT_SCHEMA",
+    "StateReplicator",
+    "StateStore",
+    "WriteAheadLog",
+    "build_table",
+    "digests_to_limbs",
+    "frame_record",
+    "fsync_dir",
+    "keyspace_of",
+    "membership_probe",
+    "nullifier_of",
+    "replace_file",
+    "replace_json",
+    "scan_frames",
+]

@@ -1,0 +1,235 @@
+"""Epoch-scoped nullifier set: the double-spend detector (PR 17).
+
+The Coconut paper's e-cash and petition applications both reduce to
+the same primitive: a credential may be SHOWN at most once (per epoch,
+for petitions: once per petition epoch). The nullifier is a
+deterministic digest of the show transcript —
+
+    sha256(b"coconut-nullifier/v1"
+           || u32 epoch (0 when unscoped)
+           || 32-byte big-endian Fiat-Shamir challenge
+           || proof.to_bytes(ctx))           # canonical wire encoding
+
+— so replaying the SAME show (same proof bytes, same challenge)
+anywhere in the fleet derives the same nullifier, while a fresh show
+of the same credential re-randomizes sigma' and derives a new one.
+That is exactly the paper's unlinkability/double-spend split: verifiers
+cannot link two honest shows, but an exact replay is caught.
+
+Two-tier membership check:
+
+  1. `probe` — a device-resident batched membership test fused ahead
+     of the verify bit: spent digests become rows of a SORTED
+     [n, 8]-limb uint32 table (big-endian sha256 limbs, the same limb
+     framing tpu/limbs.py uses for field elements), padded to a power
+     of two with all-ones sentinel rows, and each lane runs a
+     BRANCHLESS lower-bound (fixed log2(n) rounds of lexicographic
+     row-compare + gather — no data-dependent control flow, so the
+     whole batch stays one fused device computation). A hit clears the
+     lane's own verify bit. Advisory only: the table snapshot may lag
+     a concurrent commit.
+  2. `commit` — the authoritative host-side check-and-set under the
+     store lock: accepted lanes re-check against the live set AND
+     against each other (an intra-batch replay pair must not both
+     land), then every genuinely-new nullifier is WAL-appended in ONE
+     group commit (`StateStore.put_many`, one fsync per batch) BEFORE
+     any future resolves. An acknowledged show therefore survives a
+     SIGKILL — the kill-the-witness drill in probes/probe_nullifier.py
+     is the acceptance test.
+
+Counters: "nullifier_probe_hits" (device probe masked a lane),
+"nullifier_double_spends" (commit-time rejections), and
+"nullifier_commits" (accepted + persisted)."""
+
+import hashlib
+
+import numpy as np
+
+from .. import metrics
+
+_TAG = b"coconut-nullifier/v1"
+_LIMBS = 8  # sha256 = 8 big-endian u32 limbs
+
+
+def nullifier_of(proof, challenge, epoch, params):
+    """Hex nullifier for one show transcript (deterministic under
+    replay, fresh under honest re-randomized shows)."""
+    e = 0 if epoch is None else int(epoch)
+    return hashlib.sha256(
+        _TAG
+        + e.to_bytes(4, "big")
+        + int(challenge).to_bytes(32, "big")
+        + proof.to_bytes(params.ctx)
+    ).hexdigest()
+
+
+def keyspace_of(epoch):
+    """Nullifier keyspace name for an epoch (0 = unscoped shows)."""
+    return "nullifier/%d" % (0 if epoch is None else int(epoch))
+
+
+# -- device-resident membership probe ---------------------------------------
+
+
+def digests_to_limbs(hex_digests):
+    """[n, 8] big-endian uint32 limb rows for sha256 hex digests."""
+    if not hex_digests:
+        return np.zeros((0, _LIMBS), dtype=np.uint32)
+    raw = b"".join(bytes.fromhex(d) for d in hex_digests)
+    return (
+        np.frombuffer(raw, dtype=">u4")
+        .reshape(-1, _LIMBS)
+        .astype(np.uint32)
+    )
+
+
+def build_table(hex_digests):
+    """Sorted, power-of-two-padded limb table. Sentinel rows are
+    all-ones (lexicographically above any real digest, probability
+    2^-256 aside), so the lower-bound never lands on padding for a
+    real query."""
+    rows = digests_to_limbs(sorted(set(hex_digests)))
+    n = len(rows)
+    pad = 1
+    while pad < max(1, n):
+        pad *= 2
+    if pad > n:
+        filler = np.full(
+            (pad - n, _LIMBS), 0xFFFFFFFF, dtype=np.uint32
+        )
+        rows = np.concatenate([rows, filler], axis=0)
+    return rows, n
+
+
+def _row_less(a, b, xp):
+    """Branchless lexicographic a < b over [m, 8] limb rows."""
+    lt = a < b
+    eq = a == b
+    res = lt[:, 0]
+    run = eq[:, 0]
+    for j in range(1, _LIMBS):
+        res = res | (run & lt[:, j])
+        run = run & eq[:, j]
+    return res
+
+
+def membership_probe(table, n_real, queries, xp=np):
+    """Boolean hit mask for `queries` ([m, 8] limb rows) against a
+    sorted padded `table` ([pad, 8]): fixed-depth branchless binary
+    lower-bound, then one gather + row equality. `xp` is numpy or
+    jax.numpy — the math is identical; under jnp the whole probe is
+    one traced device computation."""
+    m = queries.shape[0]
+    pad = table.shape[0]
+    if m == 0 or n_real == 0:
+        return np.zeros((m,), dtype=bool)
+    pos = xp.zeros((m,), dtype=xp.int32)
+    step = pad
+    while step > 1:
+        step //= 2
+        cand = pos + step
+        # advance while table[cand - 1] < query (classic branchless
+        # lower bound: pad is a power of two, so log2(pad) rounds)
+        go = _row_less(table[cand - 1], queries, xp)
+        pos = xp.where(go, cand, pos)
+    hit = xp.all(table[pos] == queries, axis=1) & (pos < n_real)
+    return np.asarray(hit, dtype=bool)
+
+
+class NullifierGuard:
+    """Check-and-set front for the nullifier keyspaces of a StateStore.
+
+    `probe` is the advisory device pass (fused into the show-verify
+    bit); `commit` is the authoritative host pass that WAL-persists
+    accepted nullifiers with one group commit per batch."""
+
+    def __init__(self, store, use_device=True):
+        self.store = store
+        self.use_device = use_device
+        # table cache per keyspace, keyed by spent-count (the set only
+        # grows, so a stale count means a stale table)
+        self._tables = {}
+
+    # -- advisory device probe ----------------------------------------------
+
+    def _table_for(self, ks):
+        keys = self.store.keys(ks)
+        cached = self._tables.get(ks)
+        if cached is not None and cached[0] == len(keys):
+            return cached[1], cached[2]
+        table, n_real = build_table(keys)
+        self._tables[ks] = (len(keys), table, n_real)
+        return table, n_real
+
+    def probe(self, hex_digests, epochs=None):
+        """Per-lane spent flags. Lanes are grouped by epoch keyspace;
+        each group is one batched device (or numpy-fallback) probe."""
+        n = len(hex_digests)
+        if epochs is None:
+            epochs = [None] * n
+        xp = np
+        if self.use_device:
+            try:
+                import jax.numpy as jnp
+
+                xp = jnp
+            except Exception:  # pragma: no cover - jax is baked in
+                xp = np
+        out = [False] * n
+        by_ks = {}
+        for i, (d, e) in enumerate(zip(hex_digests, epochs)):
+            by_ks.setdefault(keyspace_of(e), []).append((i, d))
+        for ks, lanes in by_ks.items():
+            table, n_real = self._table_for(ks)
+            if n_real == 0:
+                continue
+            queries = digests_to_limbs([d for _, d in lanes])
+            if xp is not np:
+                table = xp.asarray(table)
+                queries = xp.asarray(queries)
+            hits = membership_probe(table, n_real, queries, xp=xp)
+            for (i, _), h in zip(lanes, hits):
+                if h:
+                    out[i] = True
+        n_hits = sum(out)
+        if n_hits:
+            metrics.count("nullifier_probe_hits", n_hits)
+        return out
+
+    # -- authoritative commit -----------------------------------------------
+
+    def seen(self, hex_digest, epoch=None):
+        return self.store.seen(keyspace_of(epoch), hex_digest)
+
+    def commit(self, hex_digests, epochs=None, accept=None):
+        """Check-and-set under the store lock: for every lane with
+        accept[i] truthy, re-check the live set and the batch itself;
+        genuinely-new nullifiers are WAL-appended with ONE fsync per
+        keyspace group BEFORE this returns. Returns per-lane booleans:
+        True = accepted and durable, False = double spend (or the lane
+        was not accepted to begin with)."""
+        n = len(hex_digests)
+        if epochs is None:
+            epochs = [None] * n
+        if accept is None:
+            accept = [True] * n
+        ok = [False] * n
+        with self.store._lock:
+            fresh = {}  # ks -> (epoch, [(key, value), ...])
+            batch_seen = set()
+            for i, (d, e) in enumerate(zip(hex_digests, epochs)):
+                if not accept[i]:
+                    continue
+                ks = keyspace_of(e)
+                if (ks, d) in batch_seen or self.store.seen(ks, d):
+                    metrics.count("nullifier_double_spends")
+                    continue
+                batch_seen.add((ks, d))
+                fresh.setdefault(ks, (e, []))[1].append((d, 1))
+                ok[i] = True
+            for ks, (e, items) in fresh.items():
+                self.store.put_many(ks, items, epoch=e, fsync=True)
+        n_ok = sum(ok)
+        if n_ok:
+            metrics.count("nullifier_commits", n_ok)
+        return ok

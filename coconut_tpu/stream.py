@@ -221,29 +221,13 @@ class StreamState:
             "crc32": _payload_crc(payload),
             "payload": payload,
         }
-        # crash-atomic: write the WHOLE document to a fixed sibling tmp,
-        # fsync it, then rename over the checkpoint. A kill at any point
-        # leaves either the old complete file or the new complete file at
-        # `path` — never torn bytes that a restart would quarantine as
-        # `.corrupt*`. The fixed tmp name means a crash mid-write leaves
-        # at most one stale `<path>.tmp`, truncated by the next save and
-        # invisible to load (which only ever reads `path`).
-        dirn = os.path.dirname(os.path.abspath(self.path))
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(doc, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)  # atomic on POSIX
-        try:
-            # persist the rename itself: fsync the directory entry
-            dfd = os.open(dirn, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
-        except OSError:  # pragma: no cover - platform-dependent
-            pass
+        # crash-atomic (state/atomic.py — the shared tmp+fsync+replace
+        # dance): a kill at any point leaves either the old complete
+        # file or the new complete file at `path`, never torn bytes
+        # that a restart would quarantine as `.corrupt*`
+        from .state.atomic import replace_json
+
+        replace_json(self.path, doc)
 
 
 def _pin_to_device(dispatch, device):

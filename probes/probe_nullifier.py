@@ -1,0 +1,252 @@
+"""Durable-state acceptance smoke (the PR-17 kill-the-witness drill).
+
+    JAX_PLATFORMS=cpu python probes/probe_nullifier.py
+
+Runs a REAL 3-replica fleet over loopback TCP sockets, each replica
+with its own state.StateStore (per-replica WAL + snapshot) and a
+state.StateReplicator pulling anti-entropy gaps from its peers, the
+gaps advertised by per-keyspace high-water marks on the health beacon.
+Asserts the properties ISSUE 17 promises:
+
+  - a full credential session round-trips THROUGH the wire and its
+    accepted show commits a nullifier to the witness's WAL before the
+    client's future resolves;
+  - the fact REPLICATES: both non-witness replicas converge on the
+    nullifier via beacon marks + anti-entropy pulls over real sockets;
+  - the witnessing replica is KILLED (listener and connections torn
+    down, engine NOT drained — the in-memory set is gone with the
+    process); replaying the same show against each survivor is still
+    rejected with the typed, wire-coded DoubleSpendError carrying the
+    nullifier digest;
+  - the witness RESTARTS over the same data directory: a fresh
+    StateStore replays its WAL and the reborn replica rejects the
+    replay too — no operator action, no peer round-trip needed;
+  - a FRESH re-randomized show of the same credential still verifies
+    (double-spend detection never collapses into linkability).
+
+Prints a one-line JSON report for the CI log. Everything runs on the
+CPU in well under a minute.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from coconut_tpu import metrics, net
+from coconut_tpu.backend import get_backend
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.engine import ProtocolEngine
+from coconut_tpu.errors import DoubleSpendError
+from coconut_tpu.keygen import trusted_party_SSS_keygen
+from coconut_tpu.params import Params
+from coconut_tpu.sss import rand_fr
+from coconut_tpu.state import StateReplicator, StateStore, nullifier_of
+
+THRESHOLD, TOTAL = 2, 3
+REPLICAS = ("rA", "rB", "rC")
+WITNESS = "rA"
+
+
+def _engine(signers, params, backend, store):
+    return ProtocolEngine(
+        signers,
+        params,
+        THRESHOLD,
+        count_hidden=1,
+        revealed_msg_indices=[1, 2],
+        backend=backend,
+        devices=1,
+        max_batch=4,
+        max_wait_ms=5.0,
+        state_store=store,
+    ).start()
+
+
+def _connect(rid, replica, codec):
+    return net.GatewayClient(
+        net.SocketTransport(replica.address), codec, session=rid
+    )
+
+
+def _wait(pred, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.05)
+    return pred()
+
+
+def main():
+    metrics.reset()
+    params = Params.new(3, b"probe-nullifier")
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params)
+    backend = get_backend("python")
+    codec = net.WireCodec(params)
+    root = tempfile.mkdtemp(prefix="probe-nullifier-")
+
+    stores, engines, replicas, clients, reps = {}, {}, {}, {}, {}
+    directory = net.HealthDirectory()
+    report = {"replicas": len(REPLICAS)}
+    loop = None
+    try:
+        for rid in REPLICAS:
+            stores[rid] = StateStore(
+                os.path.join(root, rid), replica_id=rid
+            )
+            engines[rid] = _engine(signers, params, backend, stores[rid])
+            replicas[rid] = net.Replica(
+                engines[rid], codec, replica_id=rid
+            )
+            replicas[rid].serve()
+            clients[rid] = _connect(rid, replicas[rid], codec)
+        # one gossip thread feeds beacons (and their state marks) into
+        # the shared directory; one replicator per replica pulls gaps
+        # from every peer over the real sockets
+        loop = net.GossipLoop(
+            directory,
+            {
+                rid: (lambda r=rid: clients[r].poll_beacon(timeout=2.0))
+                for rid in REPLICAS
+            },
+            interval_s=0.1,
+        ).start()
+        for rid in REPLICAS:
+            peers = {p: clients[p] for p in REPLICAS if p != rid}
+            reps[rid] = StateReplicator(
+                stores[rid], directory, peers, interval_s=0.1
+            )
+            reps[rid].start()
+
+        # -- 1. the witness accepts a show and journals the nullifier -----
+        msgs = [rand_fr(), rand_fr(), rand_fr()]
+        esk, epk = elgamal_keygen(params.ctx.sig, params.g)
+        w = clients[WITNESS]
+        req, _ = w.submit_prepare(msgs, epk).result(120.0)
+        cred = w.submit_mint(req, msgs, esk).result(120.0)
+        proof, chal, rev = w.submit_show_prove(cred, msgs).result(120.0)
+        assert w.submit_show_verify(proof, rev, chal).result(120.0) is True
+        digest = nullifier_of(proof, chal, None, params)
+        assert stores[WITNESS].seen("nullifier/0", digest), (
+            "witness accepted the show without journaling its nullifier"
+        )
+
+        # -- 2. the fact replicates to both survivors over real TCP -------
+        survivors = [r for r in REPLICAS if r != WITNESS]
+        for rid in survivors:
+            assert _wait(
+                lambda r=rid: stores[r].seen("nullifier/0", digest)
+            ), "nullifier never replicated to %s" % rid
+        report["antientropy_pulls"] = metrics.get_count(
+            "state_antientropy_pulls"
+        )
+
+        # -- 3. KILL the witness (no drain: in-memory state is gone) ------
+        replicas[WITNESS].close()
+        clients[WITNESS].close()
+
+        # -- 4. survivors reject the replayed show, typed -----------------
+        rejected = 0
+        for rid in survivors:
+            try:
+                clients[rid].submit_show_verify(
+                    proof, rev, chal
+                ).result(120.0)
+            except DoubleSpendError as e:
+                assert e.nullifier == digest, (
+                    "survivor %s rejected with the wrong nullifier" % rid
+                )
+                rejected += 1
+        assert rejected == len(survivors), (
+            "only %d of %d survivors rejected the replay"
+            % (rejected, len(survivors))
+        )
+
+        # -- 5. the witness restarts: WAL replay, rejects locally ---------
+        assert engines[WITNESS].drain(timeout=60.0)
+        stores[WITNESS].close()
+        stores[WITNESS] = StateStore(
+            os.path.join(root, WITNESS), replica_id=WITNESS
+        )
+        assert stores[WITNESS].seen("nullifier/0", digest), (
+            "WAL replay lost the acknowledged nullifier"
+        )
+        engines[WITNESS] = _engine(
+            signers, params, backend, stores[WITNESS]
+        )
+        replicas[WITNESS] = net.Replica(
+            engines[WITNESS], codec, replica_id=WITNESS
+        )
+        replicas[WITNESS].serve()
+        clients[WITNESS] = _connect(WITNESS, replicas[WITNESS], codec)
+        restart_rejected = 0
+        try:
+            clients[WITNESS].submit_show_verify(
+                proof, rev, chal
+            ).result(120.0)
+        except DoubleSpendError:
+            restart_rejected = 1
+        assert restart_rejected, (
+            "restarted witness forgot the nullifier it acknowledged"
+        )
+
+        # -- 6. a FRESH show of the same credential still verifies --------
+        proof2, chal2, rev2 = clients[WITNESS].submit_show_prove(
+            cred, msgs
+        ).result(120.0)
+        assert (
+            clients[WITNESS]
+            .submit_show_verify(proof2, rev2, chal2)
+            .result(120.0)
+            is True
+        ), "double-spend detection broke honest re-shows"
+
+        report.update(
+            {
+                "nullifier": digest,
+                "survivors_rejected": rejected,
+                "restart_rejected": restart_rejected,
+                "fresh_show_accepted": 1,
+                "commits": metrics.get_count("nullifier_commits"),
+                "double_spends": metrics.get_count(
+                    "nullifier_double_spends"
+                ),
+                "wal_replayed": metrics.get_count("wal_replayed_records"),
+                "wal_fsyncs": metrics.get_count("wal_fsyncs"),
+            }
+        )
+    finally:
+        if loop is not None:
+            loop.stop(timeout=5.0)
+        for rep in reps.values():
+            rep.stop()
+        for c in clients.values():
+            c.close()
+        for r in replicas.values():
+            r.close()
+        for rid, eng in engines.items():
+            assert eng.drain(timeout=60.0), "drain timed out on %s" % rid
+        for st in stores.values():
+            st.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+    assert report["wal_replayed"] >= 1, "restart never replayed the WAL"
+    assert report["double_spends"] >= 3  # 2 survivors + restarted witness
+
+    print(json.dumps(report, sort_keys=True))
+    print(
+        "nullifier probe: ok (witness killed, %d survivors rejected the "
+        "replay, restart replayed %d WAL records and rejected it too, "
+        "fresh show accepted)"
+        % (report["survivors_rejected"], report["wal_replayed"])
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

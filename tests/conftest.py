@@ -117,6 +117,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "state: durable state plane suite (WAL framing/torn-tail "
+        "recovery, snapshot+replay StateStore, crash-point enumeration, "
+        "anti-entropy replication, nullifier double-spend detection "
+        "with the deterministic kill-the-witness drill), also run "
+        "explicitly by ci.sh's state lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

@@ -433,21 +433,23 @@ def test_dead_letter_log_roundtrip(tmp_path):
         "batch": 3,
         "credential": 1,
         "reason": "r",
-        "schema": 3,
+        "schema": 4,
         "trace_id": None,
         "span_id": None,
         "program": None,
+        "nullifier": None,
     }
     assert DeadLetterLog.read(path)[1]["batch"] == 4
     assert DeadLetterLog.read(str(tmp_path / "missing.jsonl")) == []
-    # pre-v3 lines (no schema/trace/program fields) normalize on read: the
-    # reader never needs per-version key checks
+    # pre-v4 lines (no schema/trace/program/nullifier fields) normalize
+    # on read: the reader never needs per-version key checks
     with open(path, "a") as f:
         f.write(json.dumps({"batch": 9, "credential": 0, "reason": "old"}) + "\n")
     old = DeadLetterLog.read(path)[2]
     assert old["schema"] == 1
     assert old["trace_id"] is None and old["span_id"] is None
     assert old["program"] is None
+    assert old["nullifier"] is None
 
 
 # --- checkpoint hardening --------------------------------------------------

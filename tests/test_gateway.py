@@ -39,6 +39,7 @@ from coconut_tpu.errors import (
     WIRE_ERROR_CODES,
     DeserializationError,
     DkgAbortedError,
+    DoubleSpendError,
     EpochRetiredError,
     EpochUnknownError,
     GeneralError,
@@ -144,8 +145,8 @@ def session_objects(world, engine):
 def test_frame_header_golden():
     """The 12-byte header layout is a compatibility promise — pinned."""
     frame = wire.encode_frame(0x01, b"abc", seq=7)
-    # version byte is 02 since PR 15 (epochs on the wire)
-    assert frame.hex() == "c0c702010000000700000003616263"
+    # version byte is 03 since PR 17 (state marks + anti-entropy pull)
+    assert frame.hex() == "c0c703010000000700000003616263"
     msg_type, seq, payload = wire.decode_frame(frame)
     assert (msg_type, seq, payload) == (0x01, 7, b"abc")
 
@@ -176,6 +177,7 @@ def test_beacon_golden():
         "01000000020000000440288000"
         "00000000"
         "0000"  # v2: empty epoch window (no key lifecycle)
+        "0000"  # v3: empty state-mark set (no StateStore)
     )
     d = wire.decode_beacon(wire.encode_beacon(b))
     assert d.as_dict() == b.as_dict()
@@ -197,12 +199,15 @@ def test_beacon_epoch_window_golden():
         "0002"  # two live epochs
         "0000000102"  # epoch 1: retiring (code 2)
         "0000000201"  # epoch 2: active (code 1)
+        "0000"  # v3: empty state-mark set follows the window
     )
     d = wire.decode_beacon(enc)
     assert d.epochs == ((1, "retiring"), (2, "active"))
     assert d.as_dict() == b.as_dict()
     bad = bytearray(enc)
-    bad[-1] = 0xEE  # unknown epoch-state code must refuse, not misparse
+    # the epoch-state byte now sits 2 bytes before the (empty) v3
+    # state-mark count — still must refuse, not misparse
+    bad[-3] = 0xEE
     with pytest.raises(DeserializationError, match="epoch state"):
         wire.decode_beacon(bytes(bad))
 
@@ -390,6 +395,8 @@ def test_error_codes_stable_and_unique():
         DkgAbortedError: "dkg_aborted",
         EpochUnknownError: "epoch_unknown",
         EpochRetiredError: "epoch_retired",
+        # PR 17: the replicated nullifier set's terminal rejection
+        DoubleSpendError: "double_spend",
     }
     for cls, code in expected.items():
         assert cls.code == code

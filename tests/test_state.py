@@ -1,0 +1,736 @@
+"""Durable state plane suite (PR 17).
+
+Covers the tentpole end to end:
+
+  - WAL framing: CRC frames, group-commit fsync accounting, torn-tail
+    truncation (exactly once, counted), bounded segment rotation;
+  - StateStore: WAL-append-before-apply, snapshot+replay convergence,
+    LWW conflict resolution, idempotent remote application, corrupt-
+    snapshot quarantine;
+  - CRASH-POINT ENUMERATION: a store killed at every injected fault
+    point (pre-append, mid-record, post-append-pre-fsync, mid-snapshot,
+    mid-compaction) reopens to a PREFIX of the acknowledged state —
+    no acknowledged record lost, no phantom or duplicated records;
+  - anti-entropy replication: beacon marks -> gap pull -> convergence,
+    replication-gap chaos healing, transitive spread (a fact outlives
+    its witness);
+  - the nullifier subsystem: deterministic transcript digests, device
+    probe == host probe, commit check-and-set (intra-batch duplicates
+    included), typed DoubleSpendError through the engine and over the
+    wire, dead-letter schema v4 with the nullifier attached;
+  - the DETERMINISTIC KILL-THE-WITNESS DRILL over LoopbackTransport
+    (the real-TCP twin lives in probes/probe_nullifier.py).
+
+Everything runs on the python backend with 3-message params; no real
+sleeps except bounded engine-batch waits.
+"""
+
+import json
+import os
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from coconut_tpu import metrics
+from coconut_tpu.backend import get_backend
+from coconut_tpu.elgamal import elgamal_keygen
+from coconut_tpu.engine import ProtocolEngine
+from coconut_tpu.errors import DoubleSpendError
+from coconut_tpu.faults import (
+    DeadLetterLog,
+    ReplicationChaos,
+    SimulatedCrash,
+    WalChaos,
+)
+from coconut_tpu.keygen import trusted_party_SSS_keygen
+from coconut_tpu.keylife.epoch import EpochRegistry
+from coconut_tpu.net import gossip, rpc, wire
+from coconut_tpu.net.tenant import TenantTable
+from coconut_tpu.params import Params
+from coconut_tpu.sss import rand_fr
+from coconut_tpu.state import (
+    NullifierGuard,
+    StateReplicator,
+    StateStore,
+    WriteAheadLog,
+    build_table,
+    digests_to_limbs,
+    frame_record,
+    membership_probe,
+    nullifier_of,
+    scan_frames,
+)
+
+pytestmark = pytest.mark.state
+
+MSGS = 3
+HIDDEN = 1
+REVEALED = [1, 2]
+THRESHOLD, TOTAL = 2, 3
+
+
+@pytest.fixture(scope="module")
+def world():
+    params = Params.new(MSGS, b"test-state")
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params)
+    return SimpleNamespace(
+        params=params,
+        signers=signers,
+        backend=get_backend("python"),
+        codec=wire.WireCodec(params),
+    )
+
+
+def _engine(world, store=None, dlq=None):
+    return ProtocolEngine(
+        world.signers,
+        world.params,
+        THRESHOLD,
+        count_hidden=HIDDEN,
+        revealed_msg_indices=REVEALED,
+        backend=world.backend,
+        devices=1,
+        max_batch=4,
+        max_wait_ms=5.0,
+        state_store=store,
+        dead_letter_path=dlq,
+    ).start()
+
+
+def _session(world, eng):
+    """prepare -> mint -> show_prove; returns (proof, challenge,
+    revealed) ready for show_verify."""
+    msgs = [rand_fr() for _ in range(MSGS)]
+    esk, epk = elgamal_keygen(world.params.ctx.sig, world.params.g)
+    sig_req, _ = eng.submit_prepare(msgs, epk).result(120.0)
+    cred = eng.submit_mint(sig_req, msgs, esk).result(120.0)
+    return eng.submit_show_prove(cred, msgs).result(120.0), cred, msgs
+
+
+# --- WAL framing and recovery -----------------------------------------------
+
+
+def test_frame_roundtrip_and_torn_tail_scan():
+    frames = b"".join(frame_record(b"rec%d" % i) for i in range(5))
+    payloads, valid = scan_frames(frames)
+    assert payloads == [b"rec%d" % i for i in range(5)]
+    assert valid == len(frames)
+    # torn mid-record: prefix survives, tail is invalid
+    torn = frames + frame_record(b"tail")[:7]
+    payloads, valid = scan_frames(torn)
+    assert payloads == [b"rec%d" % i for i in range(5)]
+    assert valid == len(frames)
+    # corrupt CRC stops the scan at the bad frame
+    corrupt = bytearray(frames)
+    corrupt[-2] ^= 0xFF
+    payloads, _ = scan_frames(bytes(corrupt))
+    assert payloads == [b"rec%d" % i for i in range(4)]
+
+
+def test_wal_append_replay_and_group_commit_fsyncs(tmp_path):
+    metrics.reset()
+    w = WriteAheadLog(str(tmp_path / "wal.log"))
+    w.append(b"one")
+    w.append_many([b"two", b"three", b"four"])
+    assert metrics.get_count("wal_appends") == 4
+    # THE fsync policy: one per append call, not one per record
+    assert metrics.get_count("wal_fsyncs") == 2
+    w.close()
+    w2 = WriteAheadLog(str(tmp_path / "wal.log"))
+    assert w2.replay() == [b"one", b"two", b"three", b"four"]
+    assert metrics.get_count("wal_replayed_records") == 4
+    assert metrics.get_count("wal_torn_tails") == 0
+    w2.close()
+
+
+def test_wal_torn_tail_truncated_exactly_once(tmp_path):
+    metrics.reset()
+    path = str(tmp_path / "wal.log")
+    w = WriteAheadLog(path)
+    w.append_many([b"a", b"b"])
+    w.close()
+    with open(path, "ab") as f:
+        f.write(frame_record(b"torn-record")[:9])
+    size_torn = os.path.getsize(path)
+    w2 = WriteAheadLog(path)
+    assert metrics.get_count("wal_torn_tails") == 1
+    assert os.path.getsize(path) < size_torn
+    assert w2.replay() == [b"a", b"b"]
+    w2.close()
+    # reopening the CLEAN file must not count another truncation
+    w3 = WriteAheadLog(path)
+    assert metrics.get_count("wal_torn_tails") == 1
+    assert w3.replay() == [b"a", b"b"]
+    w3.close()
+
+
+def test_wal_torn_write_injection(tmp_path):
+    metrics.reset()
+    path = str(tmp_path / "wal.log")
+    chaos = WalChaos(torn_on={2})
+    w = WriteAheadLog(path, chaos=chaos)
+    w.append_many([b"a", b"b"])
+    with pytest.raises(SimulatedCrash):
+        w.append(b"c")  # append index 2: half the frame lands
+    assert chaos.torn_writes == 1
+    w.close()
+    w2 = WriteAheadLog(path)
+    # the torn half-frame is truncated (counted), acknowledged
+    # records survive
+    assert metrics.get_count("wal_torn_tails") == 1
+    assert w2.replay() == [b"a", b"b"]
+    w2.close()
+
+
+def test_wal_fsync_failure_injection(tmp_path):
+    chaos = WalChaos(fsync_fail_on={0})
+    w = WriteAheadLog(str(tmp_path / "wal.log"), chaos=chaos)
+    with pytest.raises(OSError):
+        w.append(b"a")
+    # the record may be in the page cache but was never acknowledged;
+    # the NEXT fsync succeeds and covers it
+    w.append(b"b")
+    w.close()
+    w2 = WriteAheadLog(str(tmp_path / "wal.log"))
+    assert w2.replay() == [b"a", b"b"]
+    w2.close()
+
+
+def test_wal_segment_rotation_bounded(tmp_path):
+    metrics.reset()
+    path = str(tmp_path / "wal.log")
+    w = WriteAheadLog(path, segment_bytes=64, keep=2)
+    for i in range(20):
+        w.append(b"record-%04d" % i)
+    assert metrics.get_count("wal_segments_rotated") > 0
+    # the chain is bounded: active + at most `keep` rotated segments
+    segs = [p for p in (path, path + ".1", path + ".2", path + ".3")
+            if os.path.exists(p)]
+    assert path + ".3" not in segs
+    # replay returns the SUFFIX the bounded chain retains, oldest
+    # first, ending at the newest record
+    replayed = w.replay()
+    assert replayed[-1] == b"record-0019"
+    assert replayed == sorted(replayed)
+    w.close()
+
+
+# --- StateStore -------------------------------------------------------------
+
+
+def test_store_put_get_replay_and_compaction(tmp_path):
+    root = str(tmp_path / "s")
+    s = StateStore(root, replica_id="rA")
+    s.put("ks", "k1", {"x": 1})
+    s.put("ks", "k2", [1, 2, 3])
+    s.delete("ks", "k1")
+    assert s.get("ks", "k1") is None
+    assert not s.seen("ks", "k1")
+    assert s.get("ks", "k2") == [1, 2, 3]
+    s.close()
+    # replay rebuilds the image, including the tombstone
+    s2 = StateStore(root, replica_id="rA")
+    assert s2.get("ks", "k1") is None
+    assert s2.get("ks", "k2") == [1, 2, 3]
+    assert s2.marks() == (("ks", "rA", 3),)
+    s2.compact()
+    assert s2.wal.size_bytes() == 0
+    s2.put("ks", "k3", "post-compact")
+    s2.close()
+    # snapshot + post-compact WAL tail converge
+    s3 = StateStore(root, replica_id="rA")
+    assert s3.get("ks", "k2") == [1, 2, 3]
+    assert s3.get("ks", "k3") == "post-compact"
+    assert s3.marks() == (("ks", "rA", 4),)
+    s3.close()
+
+
+def test_store_corrupt_snapshot_quarantined(tmp_path):
+    metrics.reset()
+    root = str(tmp_path / "s")
+    s = StateStore(root, replica_id="rA")
+    s.put("ks", "k", 1)
+    s.compact()
+    s.put("ks", "k2", 2)  # lives only in the WAL tail
+    s.close()
+    with open(s.snap_path, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff")
+    s2 = StateStore(root, replica_id="rA")
+    assert metrics.get_count("state_snapshot_corrupt") == 1
+    assert os.path.exists(s.snap_path + ".corrupt")
+    # the snapshot is gone, but the post-compaction WAL tail replays:
+    # the store degrades, never trusts corrupt bytes
+    assert s2.get("ks", "k2") == 2
+    assert s2.get("ks", "k") is None
+    s2.close()
+
+
+def test_store_lww_by_epoch_then_seq(tmp_path):
+    s = StateStore(str(tmp_path / "s"), replica_id="rA")
+    # remote record with a HIGHER epoch beats a later local lower-epoch
+    s.apply_remote(
+        [{"ks": "ks", "k": "k", "v": "high", "o": "rB", "s": 1,
+          "e": 5, "t": 0}]
+    )
+    s.put("ks", "k", "low", epoch=1)
+    assert s.get("ks", "k") == "high"
+    # same epoch: higher apply index wins
+    s.apply_remote(
+        [{"ks": "ks", "k": "k", "v": "newer", "o": "rB", "s": 2,
+          "e": 5, "t": 0}]
+    )
+    assert s.get("ks", "k") == "newer"
+    s.close()
+
+
+def test_store_records_after_serves_replicated_facts(tmp_path):
+    """A replica serves records it merely replicated — the transitive
+    spread that lets facts outlive their witness."""
+    a = StateStore(str(tmp_path / "a"), replica_id="rA")
+    b = StateStore(str(tmp_path / "b"), replica_id="rB")
+    a.put("ks", "k", "fact")
+    assert b.apply_remote(a.records_after("ks", "rA", 0)) == 1
+    # B now serves rA's records from its own log
+    page = b.records_after("ks", "rA", 0)
+    assert len(page) == 1 and page[0]["o"] == "rA"
+    c = StateStore(str(tmp_path / "c"), replica_id="rC")
+    assert c.apply_remote(page) == 1
+    assert c.seen("ks", "k")
+    a.close(), b.close(), c.close()
+
+
+# --- crash-point enumeration (satellite) ------------------------------------
+
+CRASH_POINTS = (
+    "wal.pre_append",
+    "wal.mid_record",  # via torn-write injection
+    "wal.post_append",  # post-append, pre-fsync
+    "store.mid_snapshot",
+    "store.mid_compact",
+)
+
+
+def _drive_until_crash(root, chaos):
+    """Apply a deterministic workload to a fresh store under `chaos`;
+    returns the keys ACKNOWLEDGED (call returned) before the kill."""
+    acked = []
+    store = None
+    try:
+        store = StateStore(root, replica_id="rA", chaos=chaos)
+        for i in range(6):
+            if i == 3:
+                store.compact()
+            store.put("ks", "k%d" % i, i)
+            acked.append("k%d" % i)
+    except (SimulatedCrash, OSError):
+        pass  # the "process" dies here; the object is abandoned
+    finally:
+        if store is not None:
+            try:
+                store.wal.close()
+            except Exception:
+                pass
+    return acked
+
+
+@pytest.mark.parametrize("point", CRASH_POINTS)
+def test_crash_point_enumeration_replay_converges(tmp_path, point):
+    """Kill the store at every injected fault point: reopening must
+    yield a PREFIX of the acknowledged writes — every acknowledged
+    record present, zero phantom keys, zero duplicated records."""
+    metrics.reset()
+    root = str(tmp_path / point.replace(".", "_"))
+    if point == "wal.mid_record":
+        chaos = WalChaos(torn_on={4})
+    else:
+        chaos = WalChaos(crash_at={point})
+    acked = _drive_until_crash(root, chaos)
+    assert chaos.crashes + chaos.torn_writes == 1
+
+    recovered = StateStore(root, replica_id="rA")
+    got = sorted(recovered.keys("ks"))
+    want_all = ["k%d" % i for i in range(6)]
+    # prefix consistency: acknowledged writes all present...
+    for k in acked:
+        assert k in got, "acknowledged %s lost at %s" % (k, point)
+    # ...and nothing invented beyond the workload's keyspace
+    assert set(got) <= set(want_all), "phantom records at %s" % point
+    # no duplicated records: per-origin log seqs strictly increase
+    log = recovered.records_after("ks", "rA", 0, limit=1000)
+    seqs = [r["s"] for r in log]
+    assert seqs == sorted(set(seqs)), "duplicated seqs at %s" % point
+    # the recovered store accepts new writes and survives a clean cycle
+    recovered.put("ks", "post", "recovery")
+    recovered.compact()
+    recovered.close()
+    final = StateStore(root, replica_id="rA")
+    assert final.get("ks", "post") == "recovery"
+    final.close()
+
+
+def test_mid_record_crash_truncates_torn_tail_once(tmp_path):
+    metrics.reset()
+    root = str(tmp_path / "torn")
+    chaos = WalChaos(torn_on={2})
+    _drive_until_crash(root, chaos)
+    StateStore(root, replica_id="rA").close()
+    assert metrics.get_count("wal_torn_tails") == 1
+    StateStore(root, replica_id="rA").close()
+    assert metrics.get_count("wal_torn_tails") == 1
+
+
+# --- nullifier derivation + device probe ------------------------------------
+
+
+def test_nullifier_deterministic_and_fresh(world):
+    eng = _engine(world)
+    try:
+        (proof, chal, rev), cred, msgs = _session(world, eng)
+        d1 = nullifier_of(proof, chal, None, world.params)
+        d2 = nullifier_of(proof, chal, None, world.params)
+        assert d1 == d2 and len(d1) == 64
+        # epoch scoping changes the digest (one show per epoch)
+        assert nullifier_of(proof, chal, 3, world.params) != d1
+        # a FRESH show of the same credential re-randomizes: new digest
+        proof2, chal2, _ = eng.submit_show_prove(cred, msgs).result(60.0)
+        assert nullifier_of(proof2, chal2, None, world.params) != d1
+    finally:
+        assert eng.drain(timeout=60.0)
+
+
+def test_membership_probe_device_matches_host():
+    import hashlib
+
+    spent = [hashlib.sha256(b"s%d" % i).hexdigest() for i in range(37)]
+    queries = spent[::3] + [
+        hashlib.sha256(b"q%d" % i).hexdigest() for i in range(11)
+    ]
+    table, n_real = build_table(spent)
+    assert table.shape == (64, 8)  # padded to a power of two
+    q = digests_to_limbs(queries)
+    host = membership_probe(table, n_real, q, xp=np)
+    import jax.numpy as jnp
+
+    dev = membership_probe(table, n_real, q, xp=jnp)
+    want = np.array([d in set(spent) for d in queries])
+    assert np.array_equal(host, want)
+    assert np.array_equal(dev, want)
+
+
+def test_guard_commit_check_and_set(tmp_path):
+    import hashlib
+
+    metrics.reset()
+    store = StateStore(str(tmp_path / "s"), replica_id="rA")
+    g = NullifierGuard(store, use_device=False)
+    d = [hashlib.sha256(b"n%d" % i).hexdigest() for i in range(3)]
+    # intra-batch duplicate: exactly one of the pair lands
+    ok = g.commit([d[0], d[1], d[0]], epochs=[1, 1, 1])
+    assert ok == [True, True, False]
+    # replay in a later batch is rejected; a new digest still lands
+    ok2 = g.commit([d[0], d[2]], epochs=[1, 1])
+    assert ok2 == [False, True]
+    # accept=False lanes are never committed
+    assert g.commit([d[2]], epochs=[2], accept=[False]) == [False]
+    assert not g.seen(d[2], epoch=2)
+    assert metrics.get_count("nullifier_commits") == 3
+    assert metrics.get_count("nullifier_double_spends") == 2
+    assert metrics.get_count("wal_fsyncs") == 2  # one per commit batch
+    store.close()
+
+
+# --- engine integration -----------------------------------------------------
+
+
+def test_engine_double_spend_typed_and_dead_lettered(world, tmp_path):
+    metrics.reset()
+    store = StateStore(str(tmp_path / "s"), replica_id="rA")
+    dlq = str(tmp_path / "dead.jsonl")
+    eng = _engine(world, store=store, dlq=dlq)
+    try:
+        (proof, chal, rev), _, _ = _session(world, eng)
+        assert eng.submit_show_verify(proof, rev, chal).result(60.0) is True
+        with pytest.raises(DoubleSpendError) as ei:
+            eng.submit_show_verify(proof, rev, chal).result(60.0)
+        assert ei.value.code == "double_spend"
+        digest = nullifier_of(proof, chal, None, world.params)
+        assert ei.value.nullifier == digest
+    finally:
+        assert eng.drain(timeout=60.0)
+    assert metrics.get_count("nullifier_commits") == 1
+    assert metrics.get_count("nullifier_double_spends") >= 1
+    # dead-letter schema v4 carries the spent nullifier
+    recs = [r for r in DeadLetterLog.read(dlq)
+            if r["reason"] == "double_spend"]
+    assert recs and recs[0]["schema"] == 4
+    assert recs[0]["nullifier"] == digest
+    assert recs[0]["program"] == "show_verify"
+    # the dead-letter index rode the store
+    assert store.keys("deadletter")
+    store.close()
+
+
+def test_engine_wal_replay_survives_restart(world, tmp_path):
+    root = str(tmp_path / "s")
+    store = StateStore(root, replica_id="rA")
+    eng = _engine(world, store=store)
+    try:
+        (proof, chal, rev), _, _ = _session(world, eng)
+        assert eng.submit_show_verify(proof, rev, chal).result(60.0) is True
+    finally:
+        assert eng.drain(timeout=60.0)
+    store.close()
+    # "restart": a fresh store over the same directory replays the WAL
+    store2 = StateStore(root, replica_id="rA")
+    eng2 = _engine(world, store=store2)
+    try:
+        with pytest.raises(DoubleSpendError):
+            eng2.submit_show_verify(proof, rev, chal).result(60.0)
+    finally:
+        assert eng2.drain(timeout=60.0)
+    store2.close()
+
+
+# --- wire codecs ------------------------------------------------------------
+
+
+def test_state_pull_and_chunk_roundtrip():
+    enc = wire.encode_state_pull("nullifier/3", "rA", 17, 256)
+    assert wire.decode_state_pull(enc) == ("nullifier/3", "rA", 17, 256)
+    recs = [
+        {"ks": "nullifier/3", "k": "ab" * 32, "v": 1, "o": "rA",
+         "s": 18, "e": 3, "t": 0},
+        {"ks": "epoch", "k": "2", "v": {"event": "retired"}, "o": "rB",
+         "s": 4, "e": None, "t": 1},
+    ]
+    assert wire.decode_state_chunk(wire.encode_state_chunk(recs)) == recs
+    assert wire.decode_state_chunk(wire.encode_state_chunk([])) == []
+
+
+def test_beacon_carries_state_marks():
+    b = wire.Beacon(
+        "r1", "healthy", 1.0, 0, False, 1, 1, 2.5,
+        state_marks=(("nullifier/0", "rA", 7), ("epoch", "r1", 2)),
+    )
+    d = wire.decode_beacon(wire.encode_beacon(b))
+    assert d.state_marks == (("nullifier/0", "rA", 7), ("epoch", "r1", 2))
+    assert d.as_dict() == b.as_dict()
+
+
+# --- replication ------------------------------------------------------------
+
+
+class _DirectPuller:
+    """Duck-typed client pulling straight from a peer store."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def pull_state(self, ks, origin, after_seq, limit):
+        return self.store.records_after(ks, origin, after_seq, limit)
+
+
+class _StaticDirectory:
+    def __init__(self, stores):
+        self.stores = stores
+
+    def state_marks(self, rid):
+        return self.stores[rid].marks()
+
+
+def test_replicator_heals_gaps_and_chaos(tmp_path):
+    metrics.reset()
+    a = StateStore(str(tmp_path / "a"), replica_id="rA")
+    b = StateStore(str(tmp_path / "b"), replica_id="rB")
+    directory = _StaticDirectory({"rA": a, "rB": b})
+    chaos = ReplicationChaos(drop_pairs={("rA", None)})
+    rep = StateReplicator(
+        b, directory, {"rA": _DirectPuller(a)}, chaos=chaos
+    )
+    a.put("ks", "k", "v")
+    assert rep.step() == 0  # partitioned: the pull is swallowed
+    assert chaos.dropped == 1
+    chaos.heal()
+    assert rep.step() == 1  # convergence after heal
+    assert b.seen("ks", "k")
+    assert rep.step() == 0  # idempotent once converged
+    assert metrics.get_count("state_antientropy_pulls") >= 1
+    a.close(), b.close()
+
+
+# --- the kill-the-witness drill (deterministic loopback twin) ---------------
+
+
+def test_kill_the_witness_loopback(world, tmp_path):
+    """Replica A witnesses a show; A is killed WITHOUT a drain; the
+    same nullifier replayed against the survivors is rejected with the
+    typed wire error; A restarts, replays its WAL, and rejects it too.
+    Fully deterministic: loopback transports, manual replication steps."""
+    metrics.reset()
+    rids = ("rA", "rB", "rC")
+    stores, engines, replicas, clients = {}, {}, {}, {}
+    try:
+        for rid in rids:
+            stores[rid] = StateStore(
+                str(tmp_path / rid), replica_id=rid
+            )
+            engines[rid] = _engine(world, store=stores[rid])
+            replicas[rid] = rpc.Replica(
+                engines[rid], world.codec, replica_id=rid
+            )
+            clients[rid] = rpc.GatewayClient(
+                rpc.LoopbackTransport(replicas[rid]), world.codec
+            )
+        (proof, chal, rev), _, _ = _session(world, engines["rA"])
+
+        # 1. replica A witnesses (and durably records) the show
+        assert (
+            clients["rA"]
+            .submit_show_verify(proof, rev, chal)
+            .result(60.0)
+            is True
+        )
+        digest = nullifier_of(proof, chal, None, world.params)
+
+        # 2. anti-entropy replicates the fact to the survivors, driven
+        # by the marks A's beacon advertises
+        directory = gossip.HealthDirectory()
+        directory.observe(clients["rA"].poll_beacon(), now=0.0)
+        assert ("nullifier/0", "rA", 1) in directory.state_marks("rA")
+        for rid in ("rB", "rC"):
+            n = StateReplicator(
+                stores[rid], directory, {"rA": clients["rA"]}
+            ).step()
+            assert n >= 1
+            assert stores[rid].seen("nullifier/0", digest)
+
+        # 3. KILL the witness — no drain, in-memory state gone
+        clients["rA"].transport.kill()
+        replicas["rA"].close()
+
+        # 4. the survivors still reject the replayed show, typed
+        for rid in ("rB", "rC"):
+            with pytest.raises(DoubleSpendError) as ei:
+                clients[rid].submit_show_verify(
+                    proof, rev, chal
+                ).result(60.0)
+            assert ei.value.code == "double_spend"
+            assert ei.value.nullifier == digest
+
+        # 5. A restarts: a fresh store over the same directory replays
+        # the WAL — the witness itself also still rejects
+        assert engines["rA"].drain(timeout=60.0)
+        engines.pop("rA")
+        stores["rA"].close()
+        stores["rA"] = StateStore(str(tmp_path / "rA"), replica_id="rA")
+        engines["rA"] = _engine(world, store=stores["rA"])
+        replicas["rA"] = rpc.Replica(
+            engines["rA"], world.codec, replica_id="rA"
+        )
+        clients["rA"] = rpc.GatewayClient(
+            rpc.LoopbackTransport(replicas["rA"]), world.codec
+        )
+        with pytest.raises(DoubleSpendError):
+            clients["rA"].submit_show_verify(
+                proof, rev, chal
+            ).result(60.0)
+    finally:
+        for rep in replicas.values():
+            rep.close()
+        for eng in engines.values():
+            assert eng.drain(timeout=60.0)
+        for st in stores.values():
+            st.close()
+
+
+# --- store adoption by existing subsystems ----------------------------------
+
+
+def test_epoch_registry_journals_and_restores(tmp_path):
+    from coconut_tpu.errors import EpochRetiredError, GeneralError
+    from coconut_tpu.keylife.epoch import KeySet
+
+    def _ks(epoch):
+        return KeySet(epoch, 0, THRESHOLD, [], vk=None)
+
+    root = str(tmp_path / "s")
+    store = StateStore(root, replica_id="rA")
+    reg = EpochRegistry(window=1, store=store)
+    reg.register(_ks(1))
+    reg.activate(1)
+    reg.register(_ks(2))
+    reg.activate(2)  # window=1: epoch 1 retires
+    assert store.get("epoch", "1") == {"event": "retired"}
+    assert store.get("epoch", "2") == {"event": "active"}
+    store.close()
+    # restart: the journal survives — retired stays retired, epoch ids
+    # stay monotonic, even before keysets are re-installed
+    store2 = StateStore(root, replica_id="rA")
+    reg2 = EpochRegistry(window=1, store=store2)
+    assert reg2.next_epoch() == 3
+    with pytest.raises(EpochRetiredError):
+        reg2.resolve(1)
+    with pytest.raises(GeneralError):
+        reg2.register(_ks(1))  # epoch 1 already used
+    store2.close()
+
+
+def test_tenant_quota_survives_restart(tmp_path):
+    root = str(tmp_path / "s")
+    store = StateStore(root, replica_id="rA")
+    table = TenantTable(store=store)
+    table.provision("acme", "key-acme", quota=3)
+    for _ in range(2):
+        table.admit("key-acme")
+    store.close()
+    # restart: the used counter is restored, not reset to zero
+    store2 = StateStore(root, replica_id="rA")
+    table2 = TenantTable(store=store2)
+    t = table2.provision("acme", "key-acme", quota=3)
+    assert t.used == 2
+    table2.admit("key-acme")
+    from coconut_tpu.errors import TenantQuotaError
+
+    with pytest.raises(TenantQuotaError):
+        table2.admit("key-acme")
+    store2.close()
+
+
+def test_dead_letter_store_index(tmp_path):
+    store = StateStore(str(tmp_path / "s"), replica_id="rA")
+    log = DeadLetterLog(str(tmp_path / "d.jsonl"), store=store)
+    log.append(batch=1, credential=2, reason="r", nullifier="ab" * 32)
+    (key,) = store.keys("deadletter")
+    rec = store.get("deadletter", key)
+    assert rec["nullifier"] == "ab" * 32 and rec["schema"] == 4
+    store.close()
+
+
+# --- concurrency ------------------------------------------------------------
+
+
+def test_concurrent_commits_no_double_accept(tmp_path):
+    """Two guards over one store racing the same digest: exactly one
+    commit wins — the check-and-set is atomic under the store lock."""
+    import hashlib
+
+    store = StateStore(str(tmp_path / "s"), replica_id="rA")
+    g = NullifierGuard(store, use_device=False)
+    digest = hashlib.sha256(b"raced").hexdigest()
+    results = []
+    barrier = threading.Barrier(4)
+
+    def worker():
+        barrier.wait()
+        results.append(g.commit([digest], epochs=[1])[0])
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sum(results) == 1
+    store.close()
