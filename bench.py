@@ -29,6 +29,10 @@ Also measured (BASELINE.md configs):
   state lane: show-verify goodput bare vs WAL-backed nullifiers    [--state]
     (ISSUE 17 — group-commit fsync per batch, ratio >=
     BENCH_STATE_MIN_RATIO (0.85); BENCH_STATE=0 skips)
+  hashmsm lane: host-vs-device hash-to-G1 + Horner-vs-bucketed MSM [--hashmsm]
+    (ISSUE 18 — bit parity + path selection asserted from counters
+    everywhere, "new path faster" floor on the real chip only;
+    BENCH_HASHMSM=0 skips)
 
 Phase timers (VERDICT round-1 item 9): host encode, device kernel, readback.
 Env knobs: BENCH_BATCH (default 1024), BENCH_REPS (default 5),
@@ -647,6 +651,139 @@ def bench_state(ge, params, extras, backend_name):
     return ratio
 
 
+def bench_hashmsm(ge, params, extras, backend_name):
+    """Hash/MSM lane (--hashmsm, ISSUE 18): the last two PROFILE_r05
+    walls, old vs new path, BOTH asserted bit-identical. (1) prepare's
+    hash stage: the host path (native cc_hash_to_g1_batch if built,
+    else the Python spec) against the device SvdW kernel, messages/s.
+    (2) show-prove's sigma MSM stage: the signed-Horner distinct MSM
+    against the bucketed Pippenger schedule at a forced window, rows/s.
+    Parity is asserted from the outputs AND from counters (the device
+    batches/fallbacks and bucketed/horner dispatch counts embedded in
+    the artifact). The "new path faster" floor is enforced only on the
+    real chip — on the CPU CI mesh the lane proves parity + path
+    selection, per the ISSUE 18 acceptance split. Knobs:
+    BENCH_HASHMSM_B (default 64), BENCH_HASHMSM_K (default 32),
+    BENCH_HASHMSM_WINDOW (default 5), BENCH_HASHMSM_REPS (default 3);
+    BENCH_HASHMSM=0 skips."""
+    import random as _random
+
+    import jax
+
+    from coconut_tpu import metrics, native
+    from coconut_tpu.ops.curve import G1_GEN, g1
+    from coconut_tpu.ops.fields import R as _FR
+    from coconut_tpu.tpu import backend as tb
+
+    B = int(os.environ.get("BENCH_HASHMSM_B", "64"))
+    k = int(os.environ.get("BENCH_HASHMSM_K", "32"))
+    window = int(os.environ.get("BENCH_HASHMSM_WINDOW", "5"))
+    reps = int(os.environ.get("BENCH_HASHMSM_REPS", "3"))
+    on_tpu = jax.default_backend() == "tpu"
+
+    be = tb.JaxBackend()
+    rng = _random.Random(0x18)
+
+    def best_of(fn):
+        best = None
+        for _ in range(reps):
+            t0 = time.time()
+            out = fn()
+            dt = time.time() - t0
+            best = dt if best is None else min(best, dt)
+        return out, best
+
+    # -- prepare hash stage: host path vs device SvdW kernel ------------
+    datas = [b"bench-hashmsm-%d" % i for i in range(B)]
+    if native.available():
+        old_name = "native"
+        old_pts, t_old = best_of(
+            lambda: list(native.hash_to_g1_batch(datas))
+        )
+    else:
+        old_name = "spec"
+        old_pts, t_old = best_of(
+            lambda: [params.ctx.hash_to_sig(d) for d in datas]
+        )
+    be.hash_to_g1_batch(datas)  # warm/compile outside the clock
+    hb0 = metrics.get_count("device_hash_batches")
+    hf0 = metrics.get_count("device_hash_fallbacks")
+    new_pts, t_new = best_of(lambda: be.hash_to_g1_batch(datas))
+    hash_batches = metrics.get_count("device_hash_batches") - hb0
+    hash_fallbacks = metrics.get_count("device_hash_fallbacks") - hf0
+    assert new_pts == old_pts, "device hash diverges from %s" % old_name
+    assert hash_batches == reps and hash_fallbacks == 0, (
+        "device path not taken: batches=%d fallbacks=%d"
+        % (hash_batches, hash_fallbacks)
+    )
+
+    # -- show-prove MSM stage: Horner vs bucketed Pippenger -------------
+    pts = [
+        [g1.mul(G1_GEN, rng.randrange(1, _FR)) for _ in range(k)]
+        for _ in range(B)
+    ]
+    scal = [[rng.randrange(_FR) for _ in range(k)] for _ in range(B)]
+    scal[0][0] = 0
+    mode0 = tb._BUCKET_MODE
+    try:
+        tb._BUCKET_MODE = "off"
+        be.msm_g1_distinct(pts, scal)  # warm
+        h0 = metrics.get_count("msm_horner_dispatches")
+        msm_old, t_msm_old = best_of(
+            lambda: be.msm_g1_distinct(pts, scal)
+        )
+        horner_disp = metrics.get_count("msm_horner_dispatches") - h0
+        tb._BUCKET_MODE = window
+        be.msm_g1_distinct(pts, scal)  # warm
+        b0 = metrics.get_count("msm_bucketed_dispatches")
+        msm_new, t_msm_new = best_of(
+            lambda: be.msm_g1_distinct(pts, scal)
+        )
+        bucket_disp = metrics.get_count("msm_bucketed_dispatches") - b0
+    finally:
+        tb._BUCKET_MODE = mode0
+    assert msm_new == msm_old, "bucketed MSM diverges from Horner"
+    assert horner_disp == reps and bucket_disp == reps, (
+        "MSM path selection wrong: horner=%d bucketed=%d"
+        % (horner_disp, bucket_disp)
+    )
+
+    hash_speedup = round(t_old / t_new, 4) if t_new else None
+    msm_speedup = (
+        round(t_msm_old / t_msm_new, 4) if t_msm_new else None
+    )
+    if on_tpu:
+        # the acceptance floor only binds on the device backend
+        assert hash_speedup and hash_speedup > 1.0, (
+            "device hash slower than %s at B=%d: x%r"
+            % (old_name, B, hash_speedup)
+        )
+        assert msm_speedup and msm_speedup > 1.0, (
+            "bucketed MSM slower than Horner at B=%d k=%d: x%r"
+            % (B, k, msm_speedup)
+        )
+    extras["hashmsm"] = {
+        "b": B,
+        "k": k,
+        "window": window,
+        "hash_old_path": old_name,
+        "hash_old_per_s": round(B / t_old, 2) if t_old else None,
+        "hash_new_per_s": round(B / t_new, 2) if t_new else None,
+        "hash_speedup": hash_speedup,
+        "msm_old_per_s": round(B / t_msm_old, 2) if t_msm_old else None,
+        "msm_new_per_s": round(B / t_msm_new, 2) if t_msm_new else None,
+        "msm_speedup": msm_speedup,
+        "device_hash_batches": hash_batches,
+        "device_hash_fallbacks": hash_fallbacks,
+        "msm_horner_dispatches": horner_disp,
+        "msm_bucketed_dispatches": bucket_disp,
+        "msm_bucket_window": metrics.get_gauge("msm_bucket_window"),
+        "parity_ok": True,
+        "timing_floor_enforced": on_tpu,
+    }
+    return hash_speedup or 0.0
+
+
 def bench_lifecycle(extras):
     """Warm-restart lane (--lifecycle, ISSUE 14): restart-to-first-SLO-
     compliant-response, cold vs warm. The compile wall is SIMULATED
@@ -1262,6 +1399,10 @@ def main():
         "--state" in sys.argv[1:]
         and os.environ.get("BENCH_STATE", "1") == "1"
     )
+    hashmsm_flag = (
+        "--hashmsm" in sys.argv[1:]
+        and os.environ.get("BENCH_HASHMSM", "1") == "1"
+    )
     # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
     # offline lanes so the CI online smokes don't pay for them
     offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
@@ -1273,6 +1414,7 @@ def main():
         or keylife_flag
         or batchverify_flag
         or state_flag
+        or hashmsm_flag
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1359,6 +1501,12 @@ def main():
         if value is None:
             value = state_ratio
             metric, unit = "state_goodput_ratio", "x"
+
+    if hashmsm_flag:
+        hash_speedup = bench_hashmsm(ge, params, extras, backend_name)
+        if value is None:
+            value = hash_speedup
+            metric, unit = "hashmsm_device_hash_speedup", "x"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

@@ -434,6 +434,53 @@ else
   echo "state bench smoke: skipped (BENCH_STATE=0)"
 fi
 
+echo "== hashmsm lane (device hash-to-G1 / bucketed Pippenger MSM) =="
+# the marker suite: SvdW map parity vs the spec and the native oracle
+# (random messages, empty message, the 255-byte DST boundary, u-values
+# driving each of the three x-candidates, the (u, p-u) identity-sum
+# edge), bucketed-vs-Horner bit parity across window sizes / ragged B /
+# zero scalars / GLV on/off, knob parsing, dispatch-counter routing,
+# and the epoch-retirement nullifier compaction satellite
+python -m pytest tests/ -m hashmsm -q
+# end-to-end acceptance smokes: prepare with the device hash FORCED on
+# (the probe asserts device_hash_batches moved and zero fallbacks), and
+# the bucketed-vs-Horner micro-probe with every lane checked against
+# the Python spec (small shapes — this is the CPU parity gate, the
+# timing story lives on the real chip)
+COCONUT_DEVICE_HASH=1 PROBE_PREPARE_B=8 JAX_PLATFORMS=cpu \
+  python probes/probe_prepare.py
+PROBE_MSM_WINDOWS=3 JAX_PLATFORMS=cpu python probes/probe_pippenger.py 4 6
+# bench smoke: old-vs-new path goodput for the hash and MSM stages,
+# parity + path selection asserted from the artifact's counters. On
+# this CPU mesh there is NO timing floor (ISSUE 18 acceptance split:
+# the "new path faster" assert binds on the device backend only).
+# BENCH_HASHMSM=0 skips the lane.
+if [ "${BENCH_HASHMSM:-1}" = "1" ]; then
+  HASHMSM_JSON=$(mktemp -d)/hashmsm.json
+  BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=8 BENCH_CHAOS=0 \
+    BENCH_HASHMSM_B=8 BENCH_HASHMSM_K=4 BENCH_HASHMSM_REPS=1 \
+    JAX_PLATFORMS=cpu python bench.py --hashmsm > "$HASHMSM_JSON"
+  HASHMSM_JSON_PATH="$HASHMSM_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["HASHMSM_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+report = json.loads(line)["hashmsm"]
+assert report["parity_ok"], report
+assert report["device_hash_fallbacks"] == 0, report
+assert report["device_hash_batches"] > 0, report
+assert report["msm_bucketed_dispatches"] > 0, report
+assert report["msm_horner_dispatches"] > 0, report
+assert report["msm_bucket_window"] == report["window"], report
+print("hashmsm bench smoke: ok (hash %s -> device x%s, msm horner -> "
+      "bucketed w=%d x%s, floor_enforced=%s)" % (
+          report["hash_old_path"], report["hash_speedup"],
+          report["window"], report["msm_speedup"],
+          report["timing_floor_enforced"]))
+EOF
+else
+  echo "hashmsm bench smoke: skipped (BENCH_HASHMSM=0)"
+fi
+
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="
 python -m pytest tests/test_obs.py -m obs -q
 # end-to-end acceptance smoke on the REAL service (CPU, stub backend):

@@ -117,6 +117,20 @@ class PrepareProgram(Program):
             bspan.set(n_pad=n_pad)
         return messages_list, pks
 
+    def shape_key(self, requests, payload_a, payload_b):
+        # the device hash-to-G1 path (PR 18) is its own jitted program
+        # per batch width: key it so a knob flip mid-run shows up as a
+        # NEW shape, never as a silent recompile under an old key —
+        # the "%ns_jit_shapes flat after warmup" proof stays sound
+        hash_path = (
+            "devhash"
+            if getattr(self.backend, "device_hash_enabled", None)
+            is not None
+            and self.backend.device_hash_enabled()
+            else "hosthash"
+        )
+        return (len(payload_a), hash_path)
+
     def demux(self, requests, result, messages_list, pks, seq, attempts,
               bspan):
         _demux_results(requests, result, self.metric_ns, self.engine.clock)
@@ -201,6 +215,22 @@ class ShowProveProgram(Program):
             metrics.count("prove_pad_lanes", n_pad)
             bspan.set(n_pad=n_pad)
         return sigs, messages_list
+
+    def shape_key(self, requests, payload_a, payload_b):
+        # the distinct-base MSM behind batch_show has two device
+        # schedules (PR 18): signed-Horner and the bucketed Pippenger
+        # path at a cost-model window. Selection is deterministic per
+        # (k, group, platform), but key the mode anyway so a forced
+        # COCONUT_MSM_WINDOW flip mid-run surfaces as a new shape —
+        # the "%ns_jit_shapes flat after warmup" proof stays sound
+        try:
+            from ..tpu import backend as tb
+
+            tb._bucket_window(0, 255)  # k=0: resolve the knob, pick nothing
+            mode = tb._BUCKET_MODE
+        except Exception:  # pragma: no cover - non-jax backend stacks
+            mode = None
+        return (len(payload_a), "msm%s" % (mode,))
 
     def demux(self, requests, result, sigs, messages_list, seq, attempts,
               bspan):

@@ -131,6 +131,14 @@ class ProtocolEngine(ExecutionEngine):
             from ..state.nullifier import NullifierGuard
 
             self.nullifiers = NullifierGuard(state_store)
+            if keychain is not None and hasattr(
+                keychain, "add_retire_hook"
+            ):
+                # epoch retirement drops that epoch's nullifier
+                # keyspace wholesale and compacts the WAL under it —
+                # submit-time _check_epoch already refuses retired
+                # shows before any membership probe would run
+                keychain.add_retire_hook(self.nullifiers.retire_epoch)
             if dead_letter_path is not None:
                 self.dead_letters = DeadLetterLog(
                     dead_letter_path, store=state_store

@@ -140,6 +140,11 @@ class EpochRegistry:
         #: and never re-issues an already-used epoch id, even before
         #: its keysets are re-installed by the lifecycle manager.
         self._store = store
+        #: callbacks fired AFTER the registry lock is released, once per
+        #: retired epoch id — the nullifier store hangs its keyspace
+        #: compaction here (state/nullifier.py retire_epoch). Fired
+        #: outside the lock because hooks may fsync/compact a WAL.
+        self._retire_hooks = []
         if store is not None:
             for key in store.keys("epoch"):
                 epoch = int(key)
@@ -149,6 +154,21 @@ class EpochRegistry:
                     self._retired.add(epoch)
         metrics.set_gauge("keylife_active_epoch", 0)
         metrics.set_gauge("keylife_live_epochs", 0)
+
+    def add_retire_hook(self, fn):
+        """Register fn(epoch_id), called after each retirement commits
+        (lock released). Errors are swallowed — a hook failure must not
+        wedge the epoch window."""
+        with self._lock:
+            self._retire_hooks.append(fn)
+
+    def _fire_retire_hooks(self, victims):
+        for epoch in victims:
+            for fn in list(self._retire_hooks):
+                try:
+                    fn(epoch)
+                except Exception:  # pragma: no cover - defensive
+                    metrics.count("keylife_retire_hook_errors")
 
     def _journal_locked(self, epoch, event):
         if self._store is not None:
@@ -194,8 +214,9 @@ class EpochRegistry:
             self._active = epoch
             metrics.count("keylife_activations")
             self._journal_locked(epoch, "active")
-            self._enforce_window_locked()
+            victims = self._enforce_window_locked()
             self._publish_locked()
+        self._fire_retire_hooks(victims)
 
     def install_gen(self, keyset):
         """Proactive refresh landed: swap epoch `keyset.epoch`'s current
@@ -241,8 +262,9 @@ class EpochRegistry:
                 entry.pins[keyset.key] = n
             else:
                 entry.pins.pop(keyset.key, None)
-            self._enforce_window_locked()
+            victims = self._enforce_window_locked()
             self._publish_locked()
+        self._fire_retire_hooks(victims)
 
     # -- resolution (verify side) --------------------------------------------
 
@@ -313,7 +335,10 @@ class EpochRegistry:
         """Bound the window: at most `window` live (ACTIVE/RETIRING)
         epochs. Oldest RETIRING epochs retire first — their key material
         is DROPPED, not archived. An epoch with live pins defers until
-        its last fan-out unpins; the ACTIVE epoch never retires."""
+        its last fan-out unpins; the ACTIVE epoch never retires.
+        Returns the retired epoch ids so callers can fire the retire
+        hooks AFTER releasing the registry lock."""
+        victims = []
         while len(self._live_ids_locked()) > self.window:
             victim = None
             for e in sorted(self._entries):
@@ -327,6 +352,8 @@ class EpochRegistry:
             self._retired.add(victim)
             self._journal_locked(victim, "retired")
             metrics.count("keylife_retirements")
+            victims.append(victim)
+        return victims
 
     def _publish_locked(self):
         metrics.set_gauge("keylife_active_epoch", self._active or 0)

@@ -676,15 +676,32 @@ def batch_prepare_blind_sign(messages_list, count_hidden, elgamal_pk, params,
     from . import native as _native
 
     hash_native = ctx.name == "G1" and _native.available()
+    hash_device = (
+        ctx.name == "G1"
+        and getattr(backend, "hash_to_g1_batch", None) is not None
+        and getattr(backend, "device_hash_enabled", None) is not None
+        and backend.device_hash_enabled()
+    )
     datas = [
         ctx.sig_to_bytes(c) + b"".join(ser.fr_to_bytes(m) for m in known)
         for c, known in zip(commitments, known_lists)
     ]
-    if hash_native:
-        # one FFI round trip for the whole batch (1,024 serial per-call
-        # hashes were the prepare phase's host wall — PROFILE_r05)
+    hs = None
+    if hash_device:
+        # the SvdW map + cofactor clear run as one jitted device program;
+        # only the cheap expand_message_xmd stays on host (PROFILE_r05
+        # named the 1,024 serial host hashes as the prepare wall)
+        try:
+            hs = backend.hash_to_g1_batch(datas)
+        except Exception:
+            from . import metrics as _metrics
+
+            _metrics.count("device_hash_fallbacks")
+            hs = None
+    if hs is None and hash_native:
+        # one FFI round trip for the whole batch
         hs = _native.hash_to_g1_batch(datas)
-    else:
+    elif hs is None:
         hs = [ctx.hash_to_sig(d) for d in datas]
 
     # the per-request h^{m_ij} terms need h, which needs the commitment

@@ -196,6 +196,22 @@ class NullifierGuard:
             metrics.count("nullifier_probe_hits", n_hits)
         return out
 
+    # -- epoch retirement ----------------------------------------------------
+
+    def retire_epoch(self, epoch):
+        """Drop a retired epoch's nullifier keyspace wholesale and
+        compact the WAL underneath it. Safe because the engine refuses
+        retired-epoch shows at submit time (EpochRetiredError) BEFORE
+        any membership probe — the set's memory is dead weight the
+        moment the epoch leaves the verification window. Returns the
+        number of nullifiers compacted away."""
+        ks = keyspace_of(epoch)
+        n = self.store.drop_keyspace(ks)
+        self._tables.pop(ks, None)
+        if n:
+            metrics.count("state_nullifiers_compacted", n)
+        return n
+
     # -- authoritative commit -----------------------------------------------
 
     def seen(self, hex_digest, epoch=None):

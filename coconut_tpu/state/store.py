@@ -307,6 +307,24 @@ class StateStore:
             )
             metrics.count("state_snapshots")
 
+    def drop_keyspace(self, keyspace):
+        """Retire a whole keyspace: remove its image, marks, and
+        per-origin logs, then compact so the WAL no longer carries the
+        dropped records either (epoch retirement wants the nullifier
+        set's memory gone wholesale, not tombstoned key-by-key).
+        Returns the number of live (non-tombstone) keys dropped."""
+        with self._lock:
+            space = self._data.pop(keyspace, {})
+            self._marks.pop(keyspace, None)
+            for key in [k for k in self._log if k[0] == keyspace]:
+                del self._log[key]
+            n = sum(1 for rec in space.values() if not rec["t"])
+            # the snapshot inside compact() is rebuilt from _log, so
+            # the dropped keyspace vanishes from disk atomically too
+            self.compact()
+            metrics.count("state_keyspaces_dropped")
+            return n
+
     def compact(self):
         """snapshot + WAL reset. A crash between the two leaves the
         snapshot AND the full WAL — replay is idempotent, so the next

@@ -146,6 +146,98 @@ def _raw_wire_enabled():
     return _RAW_WIRE
 
 
+# Device-resident hash-to-G1 (PR 18): run the CTH-v2 SvdW map +
+# cofactor clear as one jitted program instead of per-message host
+# hashing (the prepare phase's 1,024 serial native calls were the last
+# host wall PROFILE_r05 could name). Same lazy per-platform default as
+# the raw wire: on the real chip the device map wins; on the CPU test
+# mesh it would only add compiles of a ~1k-mul program for zero
+# correctness value (the map is differentially tested at small shapes).
+# COCONUT_DEVICE_HASH=0/1 overrides.
+_DEVICE_HASH = None
+
+
+def _device_hash_enabled():
+    global _DEVICE_HASH
+    if _DEVICE_HASH is None:
+        v = _os.environ.get("COCONUT_DEVICE_HASH")
+        if v is not None:
+            _DEVICE_HASH = v == "1"
+        else:
+            try:
+                _DEVICE_HASH = jax.default_backend() == "tpu"
+            except Exception:  # pragma: no cover - backend init failure
+                _DEVICE_HASH = False
+    return _DEVICE_HASH
+
+
+# Bucketed (Pippenger) distinct-MSM schedule (PR 18): window the
+# scalars, scatter points into per-row buckets, fold with the
+# running-sum trick (curve.msm_distinct_bucketed) — the table-free
+# alternative to msm_distinct_signed's Horner schedule. Selection is a
+# cost model per (effective base count, scalar bits), resolved with the
+# same lazy per-platform pattern as _comb_window_default:
+# COCONUT_MSM_WINDOW=w forces the bucketed path at window w (2..8),
+# COCONUT_MSM_WINDOW=0 forces Horner, unset -> cost-model choice on the
+# real chip and Horner on the CPU test mesh (where an extra schedule
+# only multiplies compile time for zero correctness value — parity is
+# asserted by the hashmsm test/bench lanes with the window forced).
+_BUCKET_MODE = None
+
+
+def _bucket_cost(k, nbits, w):
+    # batch-width add-equivalents per row: nwin windows of (w doublings
+    # ~0.75 add each, k scatter adds, 2*nb running-sum fold adds, 1
+    # Horner add); NO table build
+    nwin = -(-nbits // w) + 1
+    return nwin * (0.75 * w + k + 2 * (1 << (w - 1)) + 1)
+
+
+def _horner_cost(k, nbits):
+    # msm_distinct_signed: 16 chained build adds at k lanes + nwin
+    # windows of (5 doublings, k gathered adds)
+    nwin = -(-nbits // 5) + 1
+    return 16 * k + nwin * (0.75 * 5 + k)
+
+
+def _bucket_window(k, nbits):
+    """Bucketed-schedule window for an effective (post-GLV) per-row base
+    count `k` and scalar width `nbits`, or None for the Horner path.
+    The cost model's crossover sits around k ~ 64-128: below it the
+    17-entry-table Horner schedule is strictly cheaper (the sigma-pair
+    show MSM at k = 4 stays Horner unless forced), above it the bucket
+    scatter amortizes the missing table build and the larger windows."""
+    global _BUCKET_MODE
+    if _BUCKET_MODE is None:
+        v = _os.environ.get("COCONUT_MSM_WINDOW")
+        if v is not None:
+            w = int(v)
+            if w == 0:
+                _BUCKET_MODE = "off"
+            elif not 2 <= w <= 8:
+                raise ValueError(
+                    "COCONUT_MSM_WINDOW=%d unsupported: bucketed windows "
+                    "span 2..8 (uint8 digit magnitudes; 0 disables)" % w
+                )
+            else:
+                _BUCKET_MODE = w
+        else:
+            try:
+                _BUCKET_MODE = (
+                    "auto" if jax.default_backend() == "tpu" else "off"
+                )
+            except Exception:  # pragma: no cover - backend init failure
+                _BUCKET_MODE = "off"
+    if _BUCKET_MODE == "off" or k <= 0:
+        return None
+    if _BUCKET_MODE != "auto":
+        return _BUCKET_MODE
+    best = min(range(2, 9), key=lambda w: _bucket_cost(k, nbits, w))
+    if _bucket_cost(k, nbits, best) < _horner_cost(k, nbits):
+        return best
+    return None
+
+
 def _build_tables(spec_ops, bases, entries=16):
     """Host-side: per-base projective multiples 0..entries-1 as spec
     coordinate tuples (identity = (0, 1, 0), the complete-formula encoding).
@@ -393,6 +485,61 @@ def _msm_distinct_plus_offset_kernel(
     ox, oy = _unpack_pt(ox, oy)
     off = cv.affine_to_jacobian(fl, ox, oy, oinf)
     ax, ay, ainf = cv.to_affine(fl, cv.jadd(fl, acc, off))
+    return (*_pack_pt(ax, ay), ainf)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _msm_distinct_bucketed_kernel(field_is_fp2, window, x, y, inf, mag, sgn):
+    """Bucketed-schedule twin of _msm_distinct_affine_kernel. `window`
+    is a STATIC jit key (like field_is_fp2): the digit shapes [B, k,
+    nwin] differ per window, and the schedule is chosen deterministically
+    per (k, group) by _bucket_window, so each workload still compiles
+    exactly one program — the engine's <ns>_jit_shapes counters stay
+    flat after warmup."""
+    fl = cv.FP2 if field_is_fp2 else cv.FP
+    x, y = _pts_f32((x, y))
+    acc = cv.msm_distinct_bucketed(fl, x, y, inf, mag, sgn, window)
+    ax, ay, ainf = cv.to_affine(fl, acc)
+    return (*_pack_pt(ax, ay), ainf)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _msm_distinct_bucketed_plus_offset_kernel(
+    field_is_fp2, window, x, y, inf, mag, sgn, ox, oy, oinf
+):
+    """Bucketed-schedule twin of _msm_distinct_plus_offset_kernel, so
+    the PR 3 prefetch/offset seams compose with the new schedule."""
+    fl = cv.FP2 if field_is_fp2 else cv.FP
+    x, y = _pts_f32((x, y))
+    acc = cv.msm_distinct_bucketed(fl, x, y, inf, mag, sgn, window)
+    ox, oy = _unpack_pt(ox, oy)
+    off = cv.affine_to_jacobian(fl, ox, oy, oinf)
+    ax, ay, ainf = cv.to_affine(fl, cv.jadd(fl, acc, off))
+    return (*_pack_pt(ax, ay), ainf)
+
+
+@jax.jit
+def _hash_to_g1_kernel(u_digits, u_par):
+    """Device half of CTH-v2 hash_to_g1 (PR 18): u_digits uint8
+    [B, 2, 48] raw canonical digits of the two reduced field candidates
+    per message (expand_message_xmd stays on host — cheap SHA-256),
+    u_par bool [B, 2] the host-side sgn0(u) bits. One jitted program:
+    Montgomery domain entry, the SvdW straight-line map on both
+    candidates (stacked), the complete add, the static cofactor ladder,
+    affine + packed readback. Bit-identical to ops.hashing.hash_to_g1
+    (tests/test_hashmsm.py parity sweep, with the PR 3 native
+    cc_hash_to_g1_batch as a second oracle)."""
+    from . import fp as _fp_mod
+    from ..ops.curve import G1_COFACTOR
+
+    u = _fp_mod.to_mont(u_digits)  # [B, 2, L]
+    x, y = cv.svdw_map_fp(u, u_par)
+    pts = (x, y, cv.FP.ones(u_par.shape))
+    p0 = jax.tree_util.tree_map(lambda t: t[:, 0], pts)
+    p1 = jax.tree_util.tree_map(lambda t: t[:, 1], pts)
+    q = cv.jadd(cv.FP, p0, p1)
+    h = cv.scalar_mul_static(cv.FP, q, G1_COFACTOR)
+    ax, ay, ainf = cv.to_affine(cv.FP, h)
     return (*_pack_pt(ax, ay), ainf)
 
 
@@ -1073,9 +1220,14 @@ class JaxBackend(CurveBackend):
     def msm_g2_shared_many_async(self, jobs):
         return self._msm_shared_many_dispatch(_sg2, True, jobs)
 
-    def _encode_distinct(self, is_fp2, points_batch, scalars_batch):
+    def _encode_distinct(self, is_fp2, points_batch, scalars_batch,
+                         window=5):
         """Shared encode for the distinct-MSM kernels: GLV split (G1),
-        limb encoding, signed-digit recode -> (x, y, inf, mag, sgn)."""
+        limb encoding, signed-digit recode -> (x, y, inf, mag, sgn).
+        `window` picks the digit width (5 = the Horner schedule's
+        default; the bucketed schedule passes _bucket_window's choice);
+        nwin follows as ceil(bits/window) + 1 carry window over the
+        128-bit GLV halves or the full 255-bit Fr."""
         B = len(points_batch)
         k = len(points_batch[0])
         if any(len(row) != k for row in points_batch):
@@ -1105,9 +1257,10 @@ class JaxBackend(CurveBackend):
                 for row in scalars_batch
             ]
             k *= 2
-            nwin = glv.NWIN_5
+            bits = glv.HALF_BITS
         else:
-            nwin = _SIGNED_NWIN
+            bits = 255
+        nwin = -(-bits // window) + 1  # 27 / 52 at the 5-bit default
         flat_pts = [p for row in points_batch for p in row]
         if is_fp2:
             (x, y), inf = self._encode_g2_points(flat_pts)
@@ -1116,12 +1269,40 @@ class JaxBackend(CurveBackend):
         reshape = lambda t: t.reshape((B, k) + t.shape[1:])
         x, y = jax.tree_util.tree_map(reshape, (x, y))
         inf = inf.reshape(B, k)
-        mag, sgn = _signed_digits(scalars_batch, nwin=nwin)
+        mag, sgn = _signed_digits(scalars_batch, nwin=nwin, window=window)
         return x, y, inf, mag, sgn
 
+    @staticmethod
+    def _distinct_window(is_fp2, points_batch):
+        """Bucketed-vs-Horner schedule choice for a distinct-MSM batch:
+        None = Horner, else the bucketed window (_bucket_window's cost
+        model over the post-GLV effective base count and scalar width)."""
+        k0 = len(points_batch[0]) if points_batch else 0
+        glv_on = not is_fp2 and _GLV_ENABLED
+        from . import glv
+
+        return _bucket_window(
+            2 * k0 if glv_on else k0, glv.HALF_BITS if glv_on else 255
+        )
+
     def _msm_distinct(self, is_fp2, points_batch, scalars_batch):
-        return _msm_distinct_affine_kernel(
-            is_fp2, *self._encode_distinct(is_fp2, points_batch, scalars_batch)
+        from .. import metrics
+
+        w = self._distinct_window(is_fp2, points_batch)
+        if w is None:
+            metrics.count("msm_horner_dispatches")
+            return _msm_distinct_affine_kernel(
+                is_fp2,
+                *self._encode_distinct(is_fp2, points_batch, scalars_batch),
+            )
+        metrics.count("msm_bucketed_dispatches")
+        metrics.set_gauge("msm_bucket_window", w)
+        return _msm_distinct_bucketed_kernel(
+            is_fp2,
+            w,
+            *self._encode_distinct(
+                is_fp2, points_batch, scalars_batch, window=w
+            ),
         )
 
     @staticmethod
@@ -1152,10 +1333,27 @@ class JaxBackend(CurveBackend):
     def _msm_distinct_plus_offset(
         self, is_fp2, points_batch, scalars_batch, offset_handle
     ):
+        from .. import metrics
+
         ox, oy, oinf = offset_handle
-        return _msm_distinct_plus_offset_kernel(
+        w = self._distinct_window(is_fp2, points_batch)
+        if w is None:
+            metrics.count("msm_horner_dispatches")
+            return _msm_distinct_plus_offset_kernel(
+                is_fp2,
+                *self._encode_distinct(is_fp2, points_batch, scalars_batch),
+                ox,
+                oy,
+                oinf,
+            )
+        metrics.count("msm_bucketed_dispatches")
+        metrics.set_gauge("msm_bucket_window", w)
+        return _msm_distinct_bucketed_plus_offset_kernel(
             is_fp2,
-            *self._encode_distinct(is_fp2, points_batch, scalars_batch),
+            w,
+            *self._encode_distinct(
+                is_fp2, points_batch, scalars_batch, window=w
+            ),
             ox,
             oy,
             oinf,
@@ -1178,6 +1376,63 @@ class JaxBackend(CurveBackend):
         return self._msm_distinct_plus_offset(
             True, points_batch, scalars_batch, offset_handle
         )
+
+    # -- device hash-to-curve (PR 18) ---------------------------------------
+
+    @staticmethod
+    def device_hash_enabled():
+        """Whether protocol callers should route batched hash-to-G1
+        through this backend (the COCONUT_DEVICE_HASH knob; lazy
+        per-platform default — see _device_hash_enabled)."""
+        return _device_hash_enabled()
+
+    def hash_to_g1_async(self, datas, dst=None):
+        """Dispatch device-resident CTH-v2 hash_to_g1 over a batch of
+        messages: expand_message_xmd runs on host (cheap SHA-256), the
+        two reduced field candidates per message upload once as raw
+        digits (48 B each, no host Montgomery bigints), and
+        map(u0)+map(u1)+clear_cofactor executes as ONE jitted program.
+        Returns a dispatch handle; settle with hash_to_g1_wait.
+        Bit-identical to ops.hashing.hash_to_g1 and the native
+        cc_hash_to_g1_batch oracle."""
+        from .. import metrics
+        from ..ops import hashing as _H
+        from ..ops.fields import P as _P
+        from .limbs import fp_encode_raw_batch
+
+        dst = _H.DST_G1 if dst is None else dst
+        us = []
+        for m in datas:
+            b = _H.expand_message_xmd(m, dst, 128)
+            us.append(int.from_bytes(b[:64], "big") % _P)
+            us.append(int.from_bytes(b[64:], "big") % _P)
+        dig = fp_encode_raw_batch(us).reshape(len(datas), 2, -1)
+        par = np.array([u & 1 for u in us], dtype=bool).reshape(
+            len(datas), 2
+        )
+        metrics.count("device_hash_batches")
+        metrics.count("device_hash_points", len(datas))
+        return _hash_to_g1_kernel(jnp.asarray(dig), jnp.asarray(par))
+
+    @staticmethod
+    def hash_to_g1_wait(handle):
+        """Block on a hash_to_g1_async handle and decode to spec affine
+        points. Raises like the spec on the (~2^-255) identity output."""
+        ax, ay, ainf = handle
+        xs = tw.decode_batch(ax)
+        ys = tw.decode_batch(ay)
+        infs = np.asarray(ainf)
+        if infs.any():
+            raise ValueError(
+                "hash_to_g1 hit the identity (probability ~2^-255)"
+            )
+        return list(zip(xs, ys))
+
+    def hash_to_g1_batch(self, datas, dst=None):
+        """Synchronous device hash-to-G1 (dispatch + wait)."""
+        if not datas:
+            return []
+        return self.hash_to_g1_wait(self.hash_to_g1_async(datas, dst))
 
     def pairing_product_is_one(self, pairs_batch):
         B = len(pairs_batch)

@@ -303,6 +303,211 @@ def msm_shared_comb(fl, wtables, mag, sgn):
     return fold_points_any(fl, flat, k * nwin, axis_offset=0)
 
 
+def scalar_mul_static(fl, pt, k, window=4):
+    """Projective point times a STATIC positive int scalar: windowed
+    double-and-add, mirroring fp.pow_static's structure. The multiples
+    table 0..2^window-1 is built by a lax.scan of chained complete adds
+    (jadd compiled ONCE), then a scan over the static msb-first digit
+    array runs `window` doublings + one gathered add per window. The
+    dominant user is hash-to-G1's cofactor clear (G1_COFACTOR, 126 bits
+    -> 32 windows); complete RCB formulas make this valid for FULL-curve
+    points (the SvdW sum is not yet in the r-torsion subgroup)."""
+    assert k > 0
+    shape = jax.tree_util.tree_leaves(pt)[0].shape[:-1]
+    nw = (k.bit_length() + window - 1) // window
+    digits = jnp.array(
+        [(k >> (window * i)) & ((1 << window) - 1) for i in range(nw - 1, -1, -1)],
+        dtype=jnp.int32,
+    )
+
+    def tbody(prev, _):
+        return jadd(fl, prev, pt), prev  # emits multiples 0..2^window-1
+
+    _, rows = jax.lax.scan(
+        tbody, jinfinity(fl, shape), None, length=1 << window
+    )
+
+    def body(acc, d):
+        for _ in range(window):
+            acc = jdouble(fl, acc)
+        entry = jax.tree_util.tree_map(
+            lambda t: jax.lax.dynamic_index_in_dim(
+                t, d, axis=0, keepdims=False
+            ),
+            rows,
+        )
+        return jadd(fl, acc, entry), None
+
+    acc, _ = jax.lax.scan(body, jinfinity(fl, shape), digits)
+    return acc
+
+
+# --- SvdW map (device half of CTH-v2 hash_to_g1) ----------------------------
+#
+# Montgomery-encoded constants for the Fp instantiation of the spec's
+# straight-line Shallue-van de Woestijne map (ops/hashing._SVDW_FP — derived
+# there at import from the curve equation alone; re-encoded here as balanced
+# limb vectors). Resolved lazily: importing ops.hashing derives the Fp2
+# constants too, which is pointless import-time work for non-hashing users.
+_SVDW_MONT = None
+
+
+def _svdw_mont():
+    global _SVDW_MONT
+    if _SVDW_MONT is None:
+        from ..ops.hashing import _SVDW_FP
+        from .limbs import MONT_R, balanced_limbs
+        from .fp import P
+
+        import numpy as _np
+
+        def enc(v):
+            # numpy, not jnp: the first resolve may happen INSIDE a jit
+            # trace (the cached hash kernel), and arrays minted there
+            # would be cached as leaked tracers
+            return _np.asarray(
+                balanced_limbs(v * MONT_R % P), dtype=_np.float32
+            )
+
+        Z, c1, c2, c3, c4 = _SVDW_FP
+        _SVDW_MONT = (enc(Z), enc(c1), enc(c2), enc(c3), enc(c4), enc(4))
+    return _SVDW_MONT
+
+
+def svdw_map_fp(u, u_par):
+    """Batched SvdW straight-line map for G1, bit-identical to the spec
+    (ops/hashing._map_to_curve_svdw over _FpAdapter): u [..., L] field
+    elements in Montgomery limbs, u_par [...] bool = host-side sgn0(u)
+    (u is host-known — the expand_message_xmd output — so its parity
+    ships as a bit instead of being recomputed on device). Returns
+    affine (x, y) limb pytrees; the map NEVER outputs the identity or a
+    y = 0 point (E(Fp) has odd order, so x^3 + 4 has no roots in Fp and
+    the three-candidate select always lands on a curve point).
+
+    Fixed op count, branchless selects — the property the CTH-v2 spec
+    was designed around. The three candidate square roots run as ONE
+    stacked pow_static over a [..., 3] axis (the map's dominant cost,
+    ~480 Montgomery muls, same family as fp.inv)."""
+    from . import fp as _f
+    from ..ops.fields import P as _P
+
+    Z, c1, c2, c3, c4, b4 = _svdw_mont()
+    one = _f.ones_mont(u.shape[:-1])
+    tv1 = _f.mul(_f.sq(u), c1)
+    tv2 = _f.add(one, tv1)
+    tv1m = _f.sub(one, tv1)
+    tv3 = _f.inv(_f.mul(tv1m, tv2))  # inv0: fp.inv maps 0 -> 0
+    tv4 = _f.mul(_f.mul(_f.mul(u, tv1m), tv3), c3)
+    x1 = _f.sub(c2, tv4)
+    x2 = _f.add(c2, tv4)
+    t5 = _f.mul(_f.sq(tv2), tv3)
+    x3 = _f.add(_f.mul(_f.sq(t5), c4), Z)
+    xs = jnp.stack(jnp.broadcast_arrays(x1, x2, x3), axis=-2)  # [..., 3, L]
+    gxs = _f.add(_f.mul(_f.sq(xs), xs), b4)  # g(x) = x^3 + 4
+    ss = _f.pow_static(gxs, (_P + 1) // 4)  # candidate sqrt per x
+    # is_square(gx) iff s^2 == gx (P = 3 mod 4); exactly the spec's
+    # fp_sqrt-is-not-None test
+    ok = _f.is_zero(_f.sub(_f.sq(ss), gxs))  # [..., 3]
+    ok1, ok2 = ok[..., 0], ok[..., 1]
+    x = _f.select(ok1, xs[..., 0, :], _f.select(ok2, xs[..., 1, :], xs[..., 2, :]))
+    y = _f.select(ok1, ss[..., 0, :], _f.select(ok2, ss[..., 1, :], ss[..., 2, :]))
+    # sgn0 is defined on the STANDARD-domain canonical value: leave the
+    # Montgomery domain (one mul by raw 1) before the parity test
+    flip = _f.canon_parity(_f.from_mont(y)) != u_par
+    y = _f.select(flip, _f.neg(y), y)
+    return x, y
+
+
+def msm_distinct_bucketed(fl, x, y, inf, mag, sgn, window):
+    """Bucketed (Pippenger) distinct-base MSM: the table-free schedule
+    for FAT per-row base counts, where msm_distinct_signed's on-device
+    17-entry table build (16 chained adds at [B*k] width) and per-window
+    table gathers dominate.
+
+    x, y, inf: affine points [..., k]; mag/sgn: [..., k, nwin] signed
+    `window`-bit digits, msb first, magnitudes <= nb = 2^(window-1).
+    Per window (Horner over windows, msb first): `window` doublings,
+    then each of the k points is SCATTERED into its digit's bucket —
+    gather the target bucket row (take_along_axis over the [..., nb]
+    bucket axis), one complete add at batch width, one-hot writeback
+    (cheap VPU selects, no extra field muls) — then the nb buckets fold
+    with the running-sum trick (sum_b b*bucket_b in 2nb adds). Zero
+    digits never scatter (the one-hot mask is all-false), so zero
+    scalars and identity pad lanes cost nothing but the masked lanes.
+
+    Cost per window ~ k + 2*nb batch-width adds + `window` doublings,
+    with NO table build — vs the Horner schedule's 16k build adds +
+    k adds/window; the backend's _bucket_window cost model picks the
+    crossover (k ~ 64-128) and the window size. Returns a projective
+    accumulator pytree with leading dims [...]."""
+    nb = 1 << (window - 1)
+    bshape = inf.shape[:-1]
+    bdim = len(bshape)
+    k = inf.shape[-1]
+    jac = affine_to_jacobian(fl, x, y, inf)  # leaves [..., k, L]
+    acc = jinfinity(fl, bshape)
+
+    def win_body(acc, dw):
+        mw, sw = dw  # each [..., k]
+        acc = jax.lax.fori_loop(
+            0, window, lambda _, a: jdouble(fl, a), acc
+        )
+        buckets = jinfinity(fl, bshape + (nb,))
+
+        def scatter(j, bk):
+            d = jnp.take(mw, j, axis=-1).astype(jnp.int32)  # [...], 0..nb
+            sj = jnp.take(sw, j, axis=-1)
+            px, py, pz = jax.tree_util.tree_map(
+                lambda t: jnp.take(t, j, axis=bdim), jac
+            )
+            pj = (px, fl.select(sj, fl.neg(py), py), pz)
+            idx = jnp.maximum(d - 1, 0)  # bucket index; d = 0 is masked
+
+            def gather(t):  # [..., nb, L...] -> [..., L...] at idx
+                ii = idx.reshape(idx.shape + (1,) * (t.ndim - idx.ndim))
+                return jnp.squeeze(
+                    jnp.take_along_axis(t, ii, axis=bdim), axis=bdim
+                )
+
+            cur = jax.tree_util.tree_map(gather, bk)
+            new = jadd(fl, cur, pj)
+            onehot = (jnp.arange(nb) == idx[..., None]) & (
+                d[..., None] > 0
+            )  # [..., nb]
+
+            def put(bt, nt):
+                oh = onehot.reshape(
+                    onehot.shape + (1,) * (bt.ndim - onehot.ndim)
+                )
+                return jnp.where(oh, jnp.expand_dims(nt, axis=bdim), bt)
+
+            return jax.tree_util.tree_map(put, bk, new)
+
+        buckets = jax.lax.fori_loop(0, k, scatter, buckets)
+        # running-sum fold, top bucket first: total = sum_b b * bucket_b
+        rev = jax.tree_util.tree_map(
+            lambda t: jnp.flip(jnp.moveaxis(t, bdim, 0), axis=0), buckets
+        )
+
+        def fold(carry, bslice):
+            run, tot = carry
+            run = jadd(fl, run, bslice)
+            tot = jadd(fl, tot, run)
+            return (run, tot), None
+
+        (_, tot), _ = jax.lax.scan(
+            fold, (jinfinity(fl, bshape), jinfinity(fl, bshape)), rev
+        )
+        return jadd(fl, acc, tot), None
+
+    acc, _ = jax.lax.scan(
+        win_body,
+        acc,
+        (jnp.moveaxis(mag, -1, 0), jnp.moveaxis(sgn, -1, 0)),
+    )
+    return acc
+
+
 def msm_distinct_signed(fl, x, y, inf, mag, sgn):
     """Signed 5-bit windowed MSM over per-row bases (the issuance/show
     shape: per-credential points, so tables must be built on device).

@@ -275,6 +275,19 @@ def to_mont(t):
     return mul(t, _R2_BAL_J)
 
 
+_ONE_RAW_J = jnp.zeros((NLIMBS,), jnp.float32).at[0].set(1.0)
+
+
+def from_mont(t):
+    """Montgomery limbs -> limbs whose VALUE is the standard-domain
+    representative mod p: one Montgomery multiply by the raw integer 1
+    (x*R * 1 * R^-1 = x). Output is mul-class (|value| < 0.66p). Needed
+    wherever device logic must observe the standard-domain value itself
+    — e.g. canon_parity as the SvdW map's sgn0, which is defined on the
+    canonical integer, not its Montgomery image."""
+    return mul(t, _ONE_RAW_J)
+
+
 def sq(a):
     return mul(a, a)
 
@@ -350,6 +363,15 @@ def pack_canon48(t):
     65,536 lanes, all lanes checked, including negative-value lazy
     inputs (probes/probe_pack.py, 2026-08-01); re-run that probe if the
     scan structure here changes."""
+    digsT = _canon_digits(t)
+    digs = jnp.moveaxis(digsT, 0, -1)
+    return digs[..., :CANON_BYTES].astype(jnp.uint8)
+
+
+def _canon_digits(t):
+    """Exact base-256 digits of (value + 2p), limb-major [52, ...] —
+    the shared carry scan behind pack_canon48 and canon_parity. Same
+    contract as pack_canon48: |value| < 2p, |limbs| <= ~400."""
     v = t + jnp.asarray(_TWO_P_DIGITS_NP)
 
     def step(c, d):
@@ -359,8 +381,32 @@ def pack_canon48(t):
 
     vT = jnp.moveaxis(v, -1, 0)  # [52, ...]
     _, digsT = lax.scan(step, jnp.zeros(v.shape[:-1], v.dtype), vT)
-    digs = jnp.moveaxis(digsT, 0, -1)
-    return digs[..., :CANON_BYTES].astype(jnp.uint8)
+    return digsT
+
+
+def canon_parity(t):
+    """sgn0 of t: the parity bit of the canonical representative of t
+    mod p, on device — the SvdW map's y-sign test (ops/hashing.py:
+    fp_sgn0(a) = a & 1 on the canonical value).
+
+    Contract: NORMALIZED-class limbs with |value| < p (every fp.mul /
+    pow_static output qualifies at |value| < 0.66p). Then w = value + 2p
+    lies in (p, 3p), so the canonical value is w - 2p when w >= 2p and
+    w - p otherwise; p is odd, so parity(canonical) = parity(w) flipped
+    exactly when w < 2p. Both ingredients come from the same exact digit
+    scan as pack_canon48: parity(w) is digit 0 mod 2, and w >= 2p is a
+    lexicographic digit compare against 2p's digits (MS digit first;
+    value-0 inputs hit w == 2p exactly and return 0, matching
+    sgn0(0) = 0)."""
+    digsT = _canon_digits(t)  # [52, ...] exact digits of value + 2p
+    twop = jnp.asarray(_TWO_P_DIGITS_NP)
+    cmp = jnp.zeros(digsT.shape[1:], digsT.dtype)
+    for i in range(NLIMBS - 1, -1, -1):  # first nonzero diff from MSB wins
+        d = jnp.sign(digsT[i] - twop[i])
+        cmp = jnp.where(cmp != 0.0, cmp, d)
+    ge2p = cmp >= 0.0
+    par_w = jnp.mod(digsT[0], 2.0) != 0.0
+    return jnp.where(ge2p, par_w, ~par_w)
 
 
 # --- exact predicates (compress, then all-limbs-zero) -----------------------

@@ -1,0 +1,82 @@
+"""probe_pippenger.py: bucketed-vs-Horner distinct-MSM micro-probe
+(PR 18). Times the legacy signed-Horner schedule against the bucketed
+Pippenger schedule at a sweep of (B, k, window) shapes, checks every
+lane against the Python spec, and prints the per-stage split the cost
+model in tpu/backend.py (_bucket_cost/_horner_cost) predicts.
+
+Usage: python probe_pippenger.py [B] [k]   (defaults 16, 32)
+PROBE_MSM_WINDOWS=3,5 limits the window sweep."""
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import coconut_tpu.tpu
+
+coconut_tpu.tpu.enable_compile_cache()
+from coconut_tpu import metrics
+from coconut_tpu.ops.curve import G1_GEN, g1
+from coconut_tpu.ops.fields import R
+import coconut_tpu.tpu.backend as tb
+
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 16
+k = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+windows = [
+    int(w)
+    for w in os.environ.get("PROBE_MSM_WINDOWS", "3,5,8").split(",")
+]
+rng = random.Random(31)
+be = tb.JaxBackend()
+pts = [
+    [g1.mul(G1_GEN, rng.randrange(1, R)) for _ in range(k)]
+    for _ in range(B)
+]
+scal = [[rng.randrange(R) for _ in range(k)] for _ in range(B)]
+scal[0][0] = 0
+ref = [g1.msm(p, s) for p, s in zip(pts, scal)]
+
+glv_k = 2 * k if tb._GLV_ENABLED else k
+nbits = 128 if tb._GLV_ENABLED else 255
+print(
+    "B=%d k=%d (effective k=%d, %d-bit windows) horner-model=%.0f"
+    % (B, k, glv_k, nbits, tb._horner_cost(glv_k, nbits))
+)
+
+
+def run(label, mode):
+    tb._BUCKET_MODE = mode
+    t0 = time.time()
+    got = be.msm_g1_distinct(pts, scal)
+    t_build = time.time() - t0
+    t0 = time.time()
+    got = be.msm_g1_distinct(pts, scal)
+    t_warm = time.time() - t0
+    bad = sum(g != r for g, r in zip(got, ref))
+    print(
+        "%-12s bad=%d build=%6.1fs warm=%7.3fs"
+        % (label, bad, t_build, t_warm)
+    )
+    assert bad == 0, "%s: %d lanes diverge from spec" % (label, bad)
+    return t_warm
+
+
+t_h = run("horner", "off")
+h0 = metrics.get_count("msm_bucketed_dispatches")
+for w in windows:
+    t_b = run("bucket w=%d" % w, w)
+    print(
+        "  model=%.0f vs horner %.0f -> speedup x%.2f (measured)"
+        % (
+            tb._bucket_cost(glv_k, nbits, w),
+            tb._horner_cost(glv_k, nbits),
+            t_h / t_b,
+        )
+    )
+# each run() dispatches twice (build + warm)
+assert metrics.get_count("msm_bucketed_dispatches") - h0 == 2 * len(windows)
+tb._BUCKET_MODE = "auto"
+auto_w = tb._bucket_window(glv_k, nbits)
+print("auto window for effective k=%d: %s" % (glv_k, auto_w))
+tb._BUCKET_MODE = None
+print("parity OK")

@@ -125,6 +125,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "hashmsm: device hash-to-curve + bucketed-MSM suite (SvdW map "
+        "parity vs the spec/native oracle including adversarial vectors, "
+        "Pippenger bucket schedule bit-parity across window sizes, GLV "
+        "on/off, knob/counter routing), also run explicitly by ci.sh's "
+        "hashmsm lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",
