@@ -752,6 +752,31 @@ def bench_hashmsm(ge, params, extras, backend_name):
     msm_speedup = (
         round(t_msm_old / t_msm_new, 4) if t_msm_new else None
     )
+
+    # -- measured vs model crossover (PR 19, --calibrate companion) -----
+    # The cost model picks the schedule; this records whether the LIVE
+    # measurement at the benchmark shape agrees, plus where the model
+    # puts the crossover (pure arithmetic — probes/probe_pippenger.py
+    # --calibrate is the multi-shape measured sweep).
+    glv_k = 2 * k if tb._GLV_ENABLED else k
+    nbits = 128 if tb._GLV_ENABLED else 255
+    model_bucket = tb._bucket_cost(glv_k, nbits, window)
+    model_horner = tb._horner_cost(glv_k, nbits)
+    model_cross_k = next(
+        (
+            kk
+            for kk in range(1, 4097)
+            if min(
+                tb._bucket_cost(kk, nbits, w) for w in range(2, 9)
+            )
+            < tb._horner_cost(kk, nbits)
+        ),
+        None,
+    )
+    measured_winner = (
+        "bucket" if msm_speedup and msm_speedup > 1.0 else "horner"
+    )
+    model_winner = "bucket" if model_bucket < model_horner else "horner"
     if on_tpu:
         # the acceptance floor only binds on the device backend
         assert hash_speedup and hash_speedup > 1.0, (
@@ -778,10 +803,195 @@ def bench_hashmsm(ge, params, extras, backend_name):
         "msm_horner_dispatches": horner_disp,
         "msm_bucketed_dispatches": bucket_disp,
         "msm_bucket_window": metrics.get_gauge("msm_bucket_window"),
+        "calibration": {
+            "effective_k": glv_k,
+            "model_bucket_cost": round(model_bucket, 1),
+            "model_horner_cost": round(model_horner, 1),
+            "model_winner": model_winner,
+            "measured_winner": measured_winner,
+            "model_measured_agree": model_winner == measured_winner,
+            "model_crossover_k": model_cross_k,
+        },
         "parity_ok": True,
         "timing_floor_enforced": on_tpu,
     }
     return hash_speedup or 0.0
+
+
+def bench_scenarios(ge, params, extras, backend_name):
+    """Application-scenario lane (--scenarios, PR 19): a sustained
+    mixed petition/e-cash/access population run against a local
+    ProtocolEngine with an ElasticController in the loop, arrivals on
+    a compressed diurnal "day" with one flash crowd. The artifact
+    embeds the full availability timeline; the lane asserts the ISSUE
+    19 acceptance bar: goodput tracks the diurnal curve (peak-half
+    completions beat the trough half), the elastic pool size responds
+    (at least one park or unpark), p99 stays inside the SLO through
+    the flash crowd, every deliberate double-spend/re-sign is a typed
+    terminal rejection, and there are zero dangling futures and zero
+    unattributed errors. Knobs: BENCH_SCENARIOS_S (day length, default
+    48), BENCH_SCENARIOS_BASE/_PEAK (arrival rates, default 0.25/1.0),
+    BENCH_SCENARIOS_SLO_S (default 10); BENCH_SCENARIOS=0 skips."""
+    import tempfile
+
+    from coconut_tpu import metrics
+    from coconut_tpu.engine import ProtocolEngine
+    from coconut_tpu.engine.lifecycle import (
+        ElasticController,
+        ElasticPolicy,
+    )
+    from coconut_tpu.keygen import trusted_party_SSS_keygen
+    from coconut_tpu.scenarios import (
+        AccessScenario,
+        DiurnalCurve,
+        EcashScenario,
+        FlashCrowd,
+        PetitionScenario,
+        Population,
+        PopulationDriver,
+        RateSchedule,
+        ScenarioReport,
+    )
+    from coconut_tpu.state import StateStore
+
+    duration = float(os.environ.get("BENCH_SCENARIOS_S", "48"))
+    base_rate = float(os.environ.get("BENCH_SCENARIOS_BASE", "0.25"))
+    peak_rate = float(os.environ.get("BENCH_SCENARIOS_PEAK", "1.0"))
+    slo_s = float(os.environ.get("BENCH_SCENARIOS_SLO_S", "10"))
+
+    metrics.reset()
+    _, _, signers = trusted_party_SSS_keygen(2, 3, params)
+    revealed = list(range(2, ge.MSG_COUNT))
+    root = tempfile.mkdtemp(prefix="bench-scenarios-")
+    store = StateStore(root, replica_id="bench-scn")
+    engine = ProtocolEngine(
+        signers, params, 2,
+        count_hidden=2, revealed_msg_indices=revealed,
+        backend=backend_name, devices=2, max_batch=8,
+        max_wait_ms=5.0, state_store=store,
+    )
+    # phase the diurnal curve so the run STARTS at the trough, peaks
+    # mid-day, and returns to the trough — the elastic controller
+    # should shrink at the edges and grow through the middle
+    curve = DiurnalCurve(base_rate, peak_rate, duration)
+    crowd = FlashCrowd(
+        at_s=duration * 0.5, duration_s=duration * 0.12,
+        multiplier=2.0, ramp_s=duration * 0.05,
+    )
+    report = ScenarioReport(slo_s=slo_s, flash_window=crowd.window())
+    try:
+        with engine:
+            # one full warmup session outside the run: every program's
+            # serving shape compiles here, not inside the SLO window
+            from coconut_tpu.elgamal import elgamal_keygen
+            from coconut_tpu.sss import rand_fr
+
+            w_msgs = [rand_fr() for _ in range(ge.MSG_COUNT)]
+            w_esk, w_epk = elgamal_keygen(params.ctx.sig, params.g)
+            req, _ = engine.submit_prepare(w_msgs, w_epk).result(600.0)
+            cred = engine.submit_mint(req, w_msgs, w_esk).result(600.0)
+            proof, chal, rev = engine.submit_show_prove(
+                cred, w_msgs
+            ).result(600.0)
+            assert engine.submit_show_verify(proof, rev, chal).result(600.0)
+
+            elastic = ElasticController(
+                engine,
+                policy=ElasticPolicy(
+                    min_executors=1, grow_after=2, shrink_after=3
+                ),
+            )
+            mix = [
+                (2.0, PetitionScenario(
+                    engine, params, campaigns=4, resign_p=0.15,
+                )),
+                (2.0, EcashScenario(
+                    engine, params, double_spend_p=0.15,
+                )),
+                (1.0, AccessScenario(
+                    engine, params, session_range=(2, 3),
+                )),
+            ]
+            driver = PopulationDriver(
+                Population(128, n_tenants=8, seed=0x19),
+                mix,
+                RateSchedule(curve, [crowd]),
+                duration,
+                max_in_flight=64,
+                seed=0x19,
+                report=report,
+                engine=engine,
+                elastic=elastic,
+                drain_timeout_s=120.0,
+            )
+            out = driver.run()
+    finally:
+        store.close()
+        import shutil
+
+        shutil.rmtree(root, ignore_errors=True)
+
+    totals = out["totals"]
+    # zero unattributed errors, zero dangling futures
+    assert totals["failed"] == 0, (
+        "unattributed scenario errors: %r" % (out["error_codes"],)
+    )
+    assert totals["cancelled"] == 0, "dangling futures after drain"
+    assert totals["completed"] > 0, "no workflow completed"
+    # every deliberate double-spend / re-sign is a TYPED rejection
+    rejections = out["rejections"]
+    rejected_n = sum(sum(r.values()) for r in rejections.values())
+    labels = set()
+    for per in rejections.values():
+        labels.update(per)
+    assert rejected_n > 0, (
+        "adversarial fractions produced no rejection — detector dead?"
+    )
+    assert labels == {"double_spend"}, (
+        "rejections carry unexpected labels: %r" % (rejections,)
+    )
+    # goodput tracks the diurnal curve: completions per second through
+    # the mid-day peak beat the OPENING trough quarter (the closing
+    # quarter is not comparable — the drain flushes mid-day backlog
+    # into it, so completions bunch there regardless of arrival rate)
+    good = out["availability"]["per_second_goodput"]
+    day = good[: int(duration)]
+    q = len(day) // 4
+    mid = day[q : len(day) - q]
+    opening = day[:q]
+    mid_rate = sum(mid) / max(1, len(mid))
+    trough_rate = sum(opening) / max(1, len(opening))
+    assert mid_rate > trough_rate, (
+        "goodput does not track the diurnal curve: peak-half %.2f/s "
+        "vs opening trough %.2f/s" % (mid_rate, trough_rate)
+    )
+    # the elastic pool responded to the swing
+    elastic_out = out["elastic"]
+    pool_moved = (
+        (elastic_out["grown"] or 0) + (elastic_out["shrunk"] or 0) > 0
+    )
+    assert pool_moved, (
+        "elastic pool never changed size: %r" % (elastic_out,)
+    )
+    # p99 stays in SLO through the flash crowd (when the window saw
+    # any completions at all)
+    flash_p99 = out["slo"]["flash_p99_s"]
+    if out["slo"]["flash_completed"]:
+        assert flash_p99 is not None and flash_p99 <= slo_s, (
+            "flash-crowd p99 %.2fs blew the %.1fs SLO" % (flash_p99, slo_s)
+        )
+
+    extras["scenarios"] = {
+        "duration_s": duration,
+        "base_rate": base_rate,
+        "peak_rate": peak_rate,
+        "slo_s": slo_s,
+        "flash_window": crowd.window(),
+        "goodput_peak_half_per_s": round(mid_rate, 3),
+        "goodput_trough_per_s": round(trough_rate, 3),
+        "report": out,
+    }
+    return out["goodput_per_s"] or 0.0
 
 
 def bench_lifecycle(extras):
@@ -1403,6 +1613,10 @@ def main():
         "--hashmsm" in sys.argv[1:]
         and os.environ.get("BENCH_HASHMSM", "1") == "1"
     )
+    scenarios_flag = (
+        "--scenarios" in sys.argv[1:]
+        and os.environ.get("BENCH_SCENARIOS", "1") == "1"
+    )
     # BENCH_OFFLINE=0 (only meaningful with --serve/--issue) skips the
     # offline lanes so the CI online smokes don't pay for them
     offline = os.environ.get("BENCH_OFFLINE", "1") == "1" or not (
@@ -1415,6 +1629,7 @@ def main():
         or batchverify_flag
         or state_flag
         or hashmsm_flag
+        or scenarios_flag
     )
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -1507,6 +1722,12 @@ def main():
         if value is None:
             value = hash_speedup
             metric, unit = "hashmsm_device_hash_speedup", "x"
+
+    if scenarios_flag:
+        scn_goodput = bench_scenarios(ge, params, extras, backend_name)
+        if value is None:
+            value = scn_goodput
+            metric, unit = "scenario_goodput_per_sec", "workflows/sec"
 
     extras["metrics"] = metrics.snapshot()
     # static-operand cache effectiveness, surfaced at top level so a

@@ -450,6 +450,11 @@ python -m pytest tests/ -m hashmsm -q
 COCONUT_DEVICE_HASH=1 PROBE_PREPARE_B=8 JAX_PLATFORMS=cpu \
   python probes/probe_prepare.py
 PROBE_MSM_WINDOWS=3 JAX_PLATFORMS=cpu python probes/probe_pippenger.py 4 6
+# calibration mode (ISSUE 19 satellite): measured-vs-model crossover
+# sweep on tiny shapes — prints per-shape verdicts and a
+# COCONUT_MSM_WINDOW recommendation; exits nonzero on parity failure
+PROBE_MSM_WINDOWS=3 PROBE_CALIB_B=2 PROBE_CALIB_KS=4,6 \
+  JAX_PLATFORMS=cpu python probes/probe_pippenger.py --calibrate
 # bench smoke: old-vs-new path goodput for the hash and MSM stages,
 # parity + path selection asserted from the artifact's counters. On
 # this CPU mesh there is NO timing floor (ISSUE 18 acceptance split:
@@ -479,6 +484,48 @@ print("hashmsm bench smoke: ok (hash %s -> device x%s, msm horner -> "
 EOF
 else
   echo "hashmsm bench smoke: skipped (BENCH_HASHMSM=0)"
+fi
+
+echo "== scenarios lane (application workflows / population traffic model) =="
+# the marker suite: workflow state-machine runtime on a fake clock
+# (retry taxonomy, deadlines, parked-retry resubmission, drain-cancel
+# leaves no dangling frames), bit-stable seeded arrival streams
+# (golden hash), Zipf tenanting + lazy population, report attribution,
+# and the petition/e-cash/access flows end-to-end over loopback RPC
+# with typed double-spend rejections
+python -m pytest tests/ -m scenarios -q
+# end-to-end acceptance smoke: a REAL 3-replica TCP fleet (per-replica
+# WALs, anti-entropy, gossip-fed router) absorbing a mixed honest
+# population through a flash crowd — zero failed, zero cancelled, zero
+# rejections, availability timeline spanning the run
+JAX_PLATFORMS=cpu python probes/probe_scenarios.py
+# bench smoke: sustained mixed run on the local engine with the
+# elastic controller in the loop and adversarial fractions ON — the
+# artifact must show goodput tracking the diurnal curve, the pool
+# resizing, p99 inside the SLO through the flash crowd, and every
+# deliberate re-sign/double-spend as a typed rejection (asserted
+# inside the lane itself). BENCH_SCENARIOS=0 skips the lane.
+if [ "${BENCH_SCENARIOS:-1}" = "1" ]; then
+  SCN_JSON=$(mktemp -d)/scenarios.json
+  BENCH_OFFLINE=0 BENCH_BACKEND=python BENCH_BATCH=8 BENCH_CHAOS=0 \
+    BENCH_SCENARIOS_S=40 JAX_PLATFORMS=cpu \
+    python bench.py --scenarios > "$SCN_JSON"
+  SCN_JSON_PATH="$SCN_JSON" python - <<'EOF'
+import json, os
+with open(os.environ["SCN_JSON_PATH"]) as f:
+    line = f.read().strip().splitlines()[-1]
+top = json.loads(line)
+scn = top["scenarios"]
+totals = scn["report"]["totals"]
+assert totals["failed"] == 0 and totals["cancelled"] == 0, totals
+assert totals["completed"] > 0 and totals["rejected_expected"] > 0, totals
+print("scenarios bench smoke: ok (%.2f workflows/s, %d completed, "
+      "%d typed rejections, peak %.2f/s vs trough %.2f/s)"
+      % (top["value"], totals["completed"], totals["rejected_expected"],
+         scn["goodput_peak_half_per_s"], scn["goodput_trough_per_s"]))
+EOF
+else
+  echo "scenarios bench smoke: skipped (BENCH_SCENARIOS=0)"
 fi
 
 echo "== obs lane (request-scoped tracing / Perfetto export / flight recorder) =="

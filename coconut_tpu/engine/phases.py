@@ -39,14 +39,19 @@ class ShowOrder:
     """One show-verify submission: the proof plus its Fiat-Shamir
     challenge (None = recompute from the transcript at assemble time)
     and the mint epoch of the credential being shown (None = the boot
-    verkey; PR 15)."""
+    verkey; PR 15). `domain`/`tag` (PR 19) optionally scope the
+    derived nullifier to an application domain (petition campaign,
+    e-cash) with a deterministic spend tag — see state/nullifier.py."""
 
-    __slots__ = ("proof", "challenge", "epoch")
+    __slots__ = ("proof", "challenge", "epoch", "domain", "tag")
 
-    def __init__(self, proof, challenge=None, epoch=None):
+    def __init__(self, proof, challenge=None, epoch=None, domain=None,
+                 tag=None):
         self.proof = proof
         self.challenge = challenge
         self.epoch = epoch
+        self.domain = domain
+        self.tag = tag
 
 
 def _group_by_epoch(epochs):
@@ -305,6 +310,7 @@ class ShowVerifyProgram(Program):
             epochs = aux[2] if len(aux) > 2 else None
             digests = aux[3] if len(aux) > 3 else None
             null_epochs = aux[4] if len(aux) > 4 else None
+            null_domains = aux[5] if len(aux) > 5 else None
             if epochs is None:
                 out = list(batch_show_verify(
                     proofs, self.vk, params, revealed_list,
@@ -334,7 +340,9 @@ class ShowVerifyProgram(Program):
                 # the store lock is authoritative either way, so a
                 # probe failure degrades to commit-time detection.
                 try:
-                    spent = self.nullifiers.probe(digests, null_epochs)
+                    spent = self.nullifiers.probe(
+                        digests, null_epochs, domains=null_domains
+                    )
                 except Exception:
                     spent = None
                     metrics.count("nullifier_probe_errors")
@@ -378,7 +386,7 @@ class ShowVerifyProgram(Program):
             )
             for r in requests
         ]
-        digests = null_epochs = None
+        digests = null_epochs = null_domains = None
         if self.nullifiers is not None:
             from ..state.nullifier import nullifier_of
 
@@ -388,9 +396,18 @@ class ShowVerifyProgram(Program):
             null_epochs = [
                 getattr(r.sig, "epoch", None) for r in requests
             ]
+            null_domains = [
+                getattr(r.sig, "domain", None) for r in requests
+            ]
             digests = [
-                nullifier_of(p, c, e, self.params)
-                for p, c, e in zip(proofs, challenges, null_epochs)
+                nullifier_of(
+                    p, c, e, self.params,
+                    domain=dom, tag=getattr(r.sig, "tag", None),
+                )
+                for p, c, e, dom, r in zip(
+                    proofs, challenges, null_epochs, null_domains,
+                    requests,
+                )
             ]
         n_pad = max(0, self.max_batch - len(requests))
         if self.pad_partial and n_pad:
@@ -402,23 +419,26 @@ class ShowVerifyProgram(Program):
             if digests is not None:
                 digests.extend([digests[0]] * n_pad)
                 null_epochs.extend([null_epochs[0]] * n_pad)
+                null_domains.extend([null_domains[0]] * n_pad)
             metrics.count("showv_pad_lanes", n_pad)
             bspan.set(n_pad=n_pad)
         if digests is not None:
             return proofs, (
-                revealed_list, challenges, epochs, digests, null_epochs
+                revealed_list, challenges, epochs, digests, null_epochs,
+                null_domains,
             )
         if epochs is not None:
             return proofs, (revealed_list, challenges, epochs)
         return proofs, (revealed_list, challenges)
 
-    def _reject_double_spend(self, req, digest, epoch, seq, lane):
+    def _reject_double_spend(self, req, digest, epoch, seq, lane,
+                             domain=None):
         """Resolve one lane as a typed double-spend rejection (and
         dead-letter it with the spent nullifier, schema v4)."""
         from ..errors import DoubleSpendError
 
         req.span.end(error="double_spend")
-        req.future.set_exception(DoubleSpendError(digest, epoch))
+        req.future.set_exception(DoubleSpendError(digest, epoch, domain))
         if self.dead_letters is not None:
             try:
                 self.dead_letters.append(
@@ -441,6 +461,7 @@ class ShowVerifyProgram(Program):
 
         digests = aux[3] if len(aux) > 3 else None
         null_epochs = aux[4] if len(aux) > 4 else None
+        null_domains = aux[5] if len(aux) > 5 else None
         guard = self.nullifiers
         with otrace.span("demux", n=len(requests)):
             now = self.engine.clock()
@@ -457,6 +478,7 @@ class ShowVerifyProgram(Program):
                         digests[:n],
                         epochs=list(null_epochs[:n]),
                         accept=bits,
+                        domains=list(null_domains[:n]),
                     )
                 except Exception as e:
                     commit_err = e
@@ -481,15 +503,19 @@ class ShowVerifyProgram(Program):
                         # lost the check-and-set: a concurrent batch
                         # (or an intra-batch duplicate) spent it first
                         self._reject_double_spend(
-                            req, digests[i], null_epochs[i], seq, i
+                            req, digests[i], null_epochs[i], seq, i,
+                            domain=null_domains[i],
                         )
                         continue
-                    if not ok and guard.seen(digests[i], null_epochs[i]):
+                    if not ok and guard.seen(
+                        digests[i], null_epochs[i], null_domains[i]
+                    ):
                         # the fused probe masked the lane's verify bit:
                         # surface the TYPED rejection, not a bare False
                         metrics.count("nullifier_double_spends")
                         self._reject_double_spend(
-                            req, digests[i], null_epochs[i], seq, i
+                            req, digests[i], null_epochs[i], seq, i,
+                            domain=null_domains[i],
                         )
                         continue
                 n_valid += ok

@@ -131,6 +131,9 @@ class ProtocolEngine(ExecutionEngine):
             from ..state.nullifier import NullifierGuard
 
             self.nullifiers = NullifierGuard(state_store)
+            # PR 19: executor-health history rides the same store — a
+            # restarted replica remembers which devices were flapping
+            self.attach_health_journal(state_store)
             if keychain is not None and hasattr(
                 keychain, "add_retire_hook"
             ):
@@ -276,16 +279,21 @@ class ProtocolEngine(ExecutionEngine):
         )
 
     def submit_show_verify(self, proof, revealed_msgs, challenge=None,
-                           epoch=None, lane="interactive",
-                           max_wait_ms=None):
+                           epoch=None, domain=None, tag=None,
+                           lane="interactive", max_wait_ms=None):
         """Future resolves to the show verdict bool. Pass the prover's
         `challenge` to skip the transcript re-hash; None recomputes it
         (the stranger-verifier path). `epoch` is the shown credential's
-        mint epoch (None = the boot verkey)."""
+        mint epoch (None = the boot verkey). `domain`/`tag` (PR 19)
+        scope the derived nullifier to an application domain with an
+        optional deterministic 32-byte spend tag — the scenario layer's
+        hook for "once per campaign" / "a coin spends once" semantics
+        (see state/nullifier.py; no-ops without a state store)."""
         self._check_epoch(epoch)
         return self.submit_request(
             "show_verify",
-            ShowOrder(proof, challenge, epoch=epoch),
+            ShowOrder(proof, challenge, epoch=epoch, domain=domain,
+                      tag=tag),
             revealed_msgs,
             lane=lane,
             max_wait_ms=max_wait_ms,

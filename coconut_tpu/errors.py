@@ -365,8 +365,10 @@ class DoubleSpendError(CoconutError):
     the proof transcript, so replaying the same show against any
     replica that has the fact (locally witnessed, WAL-replayed, or
     anti-entropy-replicated) yields the same rejection. Carries the
-    `nullifier` hex digest and the `epoch` it is scoped to. Counted
-    under "nullifier_double_spends"."""
+    `nullifier` hex digest, the `epoch` it is scoped to, and (PR 19)
+    the application `domain` when the show was domain-scoped (petition
+    campaign, e-cash — see state/nullifier.py). Counted under
+    "nullifier_double_spends"."""
 
     code = "double_spend"
 
@@ -375,17 +377,20 @@ class DoubleSpendError(CoconutError):
     # this subclass __init__ — attribute reads must still succeed
     nullifier = None
     epoch = None
+    domain = None
 
-    def __init__(self, nullifier=None, epoch=None):
+    def __init__(self, nullifier=None, epoch=None, domain=None):
         super().__init__(
-            "credential already shown: nullifier %s is spent%s"
+            "credential already shown: nullifier %s is spent%s%s"
             % (
                 nullifier if nullifier is not None else "<unknown>",
                 "" if epoch is None else " (epoch %d)" % epoch,
+                "" if domain is None else " [domain %s]" % domain,
             )
         )
         self.nullifier = nullifier
         self.epoch = epoch
+        self.domain = domain
 
     def _restore_wire_fields(self, message):
         # the envelope carries only (code, message); the message format
@@ -393,12 +398,14 @@ class DoubleSpendError(CoconutError):
         # survive the round trip — clients match on err.nullifier, not
         # on message text
         m = re.search(
-            r"nullifier ([0-9a-f]{64}) is spent(?: \(epoch (\d+)\))?",
+            r"nullifier ([0-9a-f]{64}) is spent"
+            r"(?: \(epoch (\d+)\))?(?: \[domain ([^\]]+)\])?",
             message,
         )
         if m is not None:
             self.nullifier = m.group(1)
             self.epoch = None if m.group(2) is None else int(m.group(2))
+            self.domain = m.group(3)
 
 
 #: the 1:1 code <-> class map the wire error envelope encodes/decodes

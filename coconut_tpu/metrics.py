@@ -144,6 +144,27 @@ TransientBackendError: no resolve without durability),
 "dead_letter_errors" (lazy-durability write failures in the adopted
 subsystems, counted and survived).
 
+The APPLICATION SCENARIO layer (coconut_tpu/scenarios/, PR 19) reports
+under "scenario_*": "scenario_started" (workflows admitted by the
+population driver) and one terminal counter per outcome —
+"scenario_completed", "scenario_rejected" (EXPECTED typed rejections:
+petition re-sign / e-cash double-spend, the protections firing),
+"scenario_retry_exhausted", "scenario_deadline", "scenario_failed"
+(unattributed errors — the acceptance bar is zero), and
+"scenario_cancelled" (drain-cancelled runs — dangling futures, also
+zero on a clean drain); every started workflow lands in EXACTLY ONE of
+these, so started == the terminal sum is the no-lost-workflow check.
+Plus "scenario_retries" (typed-transient step re-submissions),
+"scenario_deferred" (arrivals refused by the bounded in-flight
+window), "scenario_thinking" (arrivals skipped because the sampled
+user was busy or in think-time), "scenario_hook_errors" (terminal-hook
+exceptions contained), and "scenario_elastic_tick_errors" (elastic
+controller ticks that raised — sizing degrades, the run continues).
+The breaker journal (serve/health.py + ExecutionEngine
+.attach_health_journal, PR 19) adds "health_journal_errors": journal
+writes that raised inside a state transition — durability degrades to
+in-memory, the transition itself never fails.
+
 THREAD SAFETY: the serving layer is the first multi-threaded writer
 (admission happens on client threads while the supervisor thread settles
 batches), so every mutation and `snapshot()` runs under one module lock —

@@ -85,6 +85,16 @@ class _RoutedFuture:
     def done(self):
         return self._fut.done()
 
+    def add_done_callback(self, fn):
+        """Callback-mode settle (PR 19): fires `fn` with the CURRENT
+        attempt's future — typed decode/raise semantics, but NO
+        synchronous failover or retry sleeps (those would run on the
+        transport reader thread). Async consumers like the scenario
+        workflow runtime classify the typed error themselves and
+        resubmit through the router, which re-routes around the
+        unhealthy replica via the gossip directory."""
+        self._fut.add_done_callback(lambda _f: fn(self._fut))
+
     def result(self, timeout=None):
         first = [True]
         last_exc = [None]
@@ -167,11 +177,11 @@ class _SessionClient:
         )
 
     def submit_show_verify(self, proof, revealed_msgs, challenge=None,
-                           epoch=None, lane="interactive",
-                           max_wait_ms=None):
+                           epoch=None, domain=None, tag=None,
+                           lane="interactive", max_wait_ms=None):
         return self._router.submit_show_verify(
             proof, revealed_msgs, challenge=challenge, epoch=epoch,
-            lane=lane, session=self.session,
+            domain=domain, tag=tag, lane=lane, session=self.session,
         )
 
 
@@ -310,10 +320,12 @@ class ReplicaRouter:
         return self._submit("show_prove", (sig, messages), lane, session)
 
     def submit_show_verify(self, proof, revealed_msgs, challenge=None,
-                           epoch=None, lane="interactive",
-                           max_wait_ms=None, session=""):
+                           epoch=None, domain=None, tag=None,
+                           lane="interactive", max_wait_ms=None,
+                           session=""):
         return self._submit(
-            "show_verify", (proof, revealed_msgs, challenge, epoch),
+            "show_verify",
+            (proof, revealed_msgs, challenge, epoch, domain, tag),
             lane, session,
         )
 

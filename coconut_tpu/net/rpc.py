@@ -703,10 +703,12 @@ class GatewayClient:
         return self._submit("show_prove", (sig, messages), lane, session)
 
     def submit_show_verify(self, proof, revealed_msgs, challenge=None,
-                           epoch=None, lane="interactive",
-                           max_wait_ms=None, session=None):
+                           epoch=None, domain=None, tag=None,
+                           lane="interactive", max_wait_ms=None,
+                           session=None):
         return self._submit(
-            "show_verify", (proof, revealed_msgs, challenge, epoch),
+            "show_verify",
+            (proof, revealed_msgs, challenge, epoch, domain, tag),
             lane, session,
         )
 

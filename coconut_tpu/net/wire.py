@@ -57,7 +57,11 @@ from ..serve.queue import LANES
 #: the replica's per-keyspace state high-water marks (the anti-entropy
 #: trigger), and MSG_STATE_PULL/MSG_STATE_CHUNK page replicated state
 #: records between replicas.
-WIRE_VERSION = 3
+#: v4 (PR 19): scenario nullifier scoping — the show_verify request
+#: carries an application domain string ("" = unscoped) and an optional
+#: 32-byte deterministic spend tag (petition campaigns, e-cash; see
+#: state/nullifier.py).
+WIRE_VERSION = 4
 
 MAGIC = 0xC0C7
 
@@ -721,12 +725,15 @@ class WireCodec:
         revealed, o = _read_revealed(b, o)
         return (proof, challenge, revealed), o
 
-    # -- show_verify: (proof, revealed, challenge, epoch) -> bool -----------
+    # -- show_verify: (proof, revealed, challenge, epoch, domain, tag)
+    #    -> bool ------------------------------------------------------------
 
     def _enc_req_show_verify(
-        self, proof, revealed_msgs, challenge=None, epoch=None
+        self, proof, revealed_msgs, challenge=None, epoch=None,
+        domain=None, tag=None,
     ):
         has = challenge is not None
+        has_tag = tag is not None
         return b"".join(
             (
                 _pack_blob(proof.to_bytes(self.ctx)),
@@ -736,6 +743,11 @@ class WireCodec:
                 # the shown credential's mint epoch (0 = unpinned): a
                 # proof is only sound against the verkey it was built for
                 _pack_epoch(epoch),
+                # v4: scenario nullifier scope — domain ("" = unscoped)
+                # and optional 32-byte deterministic spend tag
+                _pack_str(domain or ""),
+                bytes([1 if has_tag else 0]),
+                bytes(tag) if has_tag else b"",
             )
         )
 
@@ -751,7 +763,13 @@ class WireCodec:
             raw, o = _read_exact(b, o, 32, "challenge")
             challenge = ser.fr_from_bytes(raw)
         epoch, o = _read_epoch(b, o)
-        return (proof, revealed, challenge, epoch), o
+        domain, o = _read_str(b, o)
+        raw, o = _read_exact(b, o, 1, "show_verify request")
+        tag = None
+        if raw[0]:
+            raw, o = _read_exact(b, o, 32, "spend tag")
+            tag = bytes(raw)
+        return (proof, revealed, challenge, epoch, domain or None, tag), o
 
     _enc_resp_show_verify = _enc_resp_verify
     _dec_resp_show_verify = _dec_resp_verify

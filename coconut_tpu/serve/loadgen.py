@@ -227,6 +227,13 @@ def _availability(events, t0, elapsed):
     }
 
 
+#: public name for the per-second goodput/error timeline builder — the
+#: scenario layer (coconut_tpu/scenarios/report.py) builds its
+#: availability section on the SAME machinery the serve drills use
+#: rather than growing a parallel implementation (PR 19)
+availability_timeline = _availability
+
+
 def restart_to_first_slo(availability, t_mark, slo_s):
     """Seconds from `t_mark` (relative to the run's start, e.g. the
     moment a replica restart began) to the FIRST completion at/after it
@@ -241,7 +248,7 @@ def restart_to_first_slo(availability, t_mark, slo_s):
     return None if best is None else max(0.0, best - t_mark)
 
 
-def _percentiles(latencies):
+def latency_percentiles(latencies):
     return {
         "p50": metrics.percentile(latencies, 50),
         "p95": metrics.percentile(latencies, 95),
@@ -484,7 +491,7 @@ def run_loadgen(
         "valid": tally.valid,
         "invalid": tally.invalid,
         "verdict_mismatches": tally.mismatches,
-        "latency_s": _percentiles(tally.latencies),
+        "latency_s": latency_percentiles(tally.latencies),
         "rpc_overhead_s": _rpc_overhead(
             transport, tally.latencies, eng_lat0, _engine_latency_totals()
         ),
@@ -648,7 +655,7 @@ def run_session_loadgen(
             "completed": len(lats),
             "errors": phase_errors[phase],
             "goodput_per_s": round(len(lats) / elapsed, 2),
-            "latency_s": _percentiles(lats),
+            "latency_s": latency_percentiles(lats),
         }
     all_phase_lat = [dt for lats in phase_lat.values() for dt in lats]
     return {
@@ -663,7 +670,7 @@ def run_session_loadgen(
         "errors": sum(phase_errors.values()),
         "failed_shows": counts["failed_shows"],
         "sessions_per_s": round(counts["completed"] / elapsed, 2),
-        "session_latency_s": _percentiles(session_lat),
+        "session_latency_s": latency_percentiles(session_lat),
         "rpc_overhead_s": _rpc_overhead(
             transport, all_phase_lat, eng_lat0, _engine_latency_totals()
         ),
@@ -691,7 +698,7 @@ def _issue_report(t, issue_service, before_counts, elapsed):
         "errors": t.errors,
         "dropped_futures": t.dropped,
         "mint_mismatches": t.mismatches,
-        "latency_s": _percentiles(t.latencies),
+        "latency_s": latency_percentiles(t.latencies),
         "goodput_per_s": round(t.completed / elapsed, 2),
         "mean_batch_occupancy": (
             round(

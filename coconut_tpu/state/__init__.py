@@ -28,6 +28,7 @@ from .nullifier import (
     keyspace_of,
     membership_probe,
     nullifier_of,
+    spend_tag_of,
 )
 from .replicate import StateReplicator
 from .store import SNAPSHOT_SCHEMA, StateStore
@@ -59,4 +60,5 @@ __all__ = [
     "replace_file",
     "replace_json",
     "scan_frames",
+    "spend_tag_of",
 ]

@@ -37,6 +37,31 @@ Two-tier membership check:
      SIGKILL — the kill-the-witness drill in probes/probe_nullifier.py
      is the acceptance test.
 
+Scenario domains (PR 19). The paper's applications need a second axis
+of scoping: a petition campaign wants "this credential signs THIS
+campaign at most once" (while the same credential may sign OTHER
+campaigns), and e-cash wants "this coin spends at most once" even
+though every honest show re-randomizes the transcript. Both are
+expressed by an optional (domain, tag) pair on show-verify:
+
+  - `domain` — a scope string (e.g. "petition/save-the-bees",
+    "ecash"); nullifiers in different domains live in DIFFERENT
+    keyspaces and never collide.
+  - `tag` — an optional deterministic 32-byte spend tag supplied by
+    the client (see `spend_tag_of`): when present, the nullifier is
+    derived from the TAG instead of the transcript, so any re-spend of
+    the same credential in the same domain collides — not just an
+    exact replay.
+
+With both absent the derivation is byte-identical to the v1 transcript
+nullifier above (existing WALs, probes, and golden tests unaffected);
+with either present a distinct v2 derivation is used, so domain-scoped
+digests can never collide with unscoped ones. In this reproduction
+the tag is client-supplied and trusted — in the full Coconut protocol
+it would be derived in zero knowledge from a credential attribute;
+that proof is out of scope here and the seam is the scenario layer's
+simulation boundary.
+
 Counters: "nullifier_probe_hits" (device probe masked a lane),
 "nullifier_double_spends" (commit-time rejections), and
 "nullifier_commits" (accepted + persisted)."""
@@ -48,24 +73,66 @@ import numpy as np
 from .. import metrics
 
 _TAG = b"coconut-nullifier/v1"
+_TAG_V2 = b"coconut-nullifier/v2"
+_SPEND_TAG = b"coconut-spend-tag/v1"
 _LIMBS = 8  # sha256 = 8 big-endian u32 limbs
 
 
-def nullifier_of(proof, challenge, epoch, params):
-    """Hex nullifier for one show transcript (deterministic under
-    replay, fresh under honest re-randomized shows)."""
+def nullifier_of(proof, challenge, epoch, params, domain=None, tag=None):
+    """Hex nullifier for one show transcript.
+
+    Unscoped (domain and tag both None): the v1 transcript digest —
+    deterministic under replay, fresh under honest re-randomized
+    shows. Scoped: a v2 digest over (epoch, domain, material) where
+    material is the 32-byte spend `tag` when given (re-spend of the
+    same credential collides) or the transcript otherwise (replay-only
+    detection, but confined to the domain's keyspace)."""
     e = 0 if epoch is None else int(epoch)
+    if domain is None and tag is None:
+        return hashlib.sha256(
+            _TAG
+            + e.to_bytes(4, "big")
+            + int(challenge).to_bytes(32, "big")
+            + proof.to_bytes(params.ctx)
+        ).hexdigest()
+    dom = (domain or "").encode("utf-8")
+    if tag is not None:
+        material = bytes(tag)
+        if len(material) != 32:
+            raise ValueError("nullifier tag must be exactly 32 bytes")
+    else:
+        material = (
+            int(challenge).to_bytes(32, "big") + proof.to_bytes(params.ctx)
+        )
     return hashlib.sha256(
-        _TAG
+        _TAG_V2
         + e.to_bytes(4, "big")
-        + int(challenge).to_bytes(32, "big")
-        + proof.to_bytes(params.ctx)
+        + len(dom).to_bytes(2, "big")
+        + dom
+        + material
     ).hexdigest()
 
 
-def keyspace_of(epoch):
-    """Nullifier keyspace name for an epoch (0 = unscoped shows)."""
-    return "nullifier/%d" % (0 if epoch is None else int(epoch))
+def spend_tag_of(sig_bytes, domain):
+    """Deterministic 32-byte spend tag binding a credential to a
+    domain: sha256 over the MINTED credential's canonical bytes (which
+    never change — shows re-randomize a copy) and the domain string.
+    Same credential + same domain -> same tag -> the derived nullifier
+    collides on any second spend; a different domain yields an
+    unrelated tag, so one credential signs many campaigns."""
+    dom = (domain or "").encode("utf-8")
+    return hashlib.sha256(
+        _SPEND_TAG + len(dom).to_bytes(2, "big") + dom + bytes(sig_bytes)
+    ).digest()
+
+
+def keyspace_of(epoch, domain=None):
+    """Nullifier keyspace name for an (epoch, domain) scope (epoch 0 =
+    unscoped shows; no domain = the classic fleet-wide keyspace)."""
+    e = 0 if epoch is None else int(epoch)
+    if domain:
+        return "nullifier/%s/%d" % (domain, e)
+    return "nullifier/%d" % e
 
 
 # -- device-resident membership probe ---------------------------------------
@@ -161,12 +228,15 @@ class NullifierGuard:
         self._tables[ks] = (len(keys), table, n_real)
         return table, n_real
 
-    def probe(self, hex_digests, epochs=None):
-        """Per-lane spent flags. Lanes are grouped by epoch keyspace;
-        each group is one batched device (or numpy-fallback) probe."""
+    def probe(self, hex_digests, epochs=None, domains=None):
+        """Per-lane spent flags. Lanes are grouped by (epoch, domain)
+        keyspace; each group is one batched device (or numpy-fallback)
+        probe."""
         n = len(hex_digests)
         if epochs is None:
             epochs = [None] * n
+        if domains is None:
+            domains = [None] * n
         xp = np
         if self.use_device:
             try:
@@ -177,8 +247,8 @@ class NullifierGuard:
                 xp = np
         out = [False] * n
         by_ks = {}
-        for i, (d, e) in enumerate(zip(hex_digests, epochs)):
-            by_ks.setdefault(keyspace_of(e), []).append((i, d))
+        for i, (d, e, dom) in enumerate(zip(hex_digests, epochs, domains)):
+            by_ks.setdefault(keyspace_of(e, dom), []).append((i, d))
         for ks, lanes in by_ks.items():
             table, n_real = self._table_for(ks)
             if n_real == 0:
@@ -203,21 +273,32 @@ class NullifierGuard:
         compact the WAL underneath it. Safe because the engine refuses
         retired-epoch shows at submit time (EpochRetiredError) BEFORE
         any membership probe — the set's memory is dead weight the
-        moment the epoch leaves the verification window. Returns the
-        number of nullifiers compacted away."""
-        ks = keyspace_of(epoch)
-        n = self.store.drop_keyspace(ks)
-        self._tables.pop(ks, None)
+        moment the epoch leaves the verification window. Domain-scoped
+        keyspaces of the same epoch (suffix "/<epoch>") are dropped
+        alongside the classic one. Returns the number of nullifiers
+        compacted away."""
+        e = 0 if epoch is None else int(epoch)
+        suffix = "/%d" % e
+        victims = [
+            ks
+            for ks in self.store.keyspaces()
+            if ks.startswith("nullifier/") and ks.endswith(suffix)
+        ]
+        victims.append(keyspace_of(epoch))
+        n = 0
+        for ks in dict.fromkeys(victims):
+            n += self.store.drop_keyspace(ks)
+            self._tables.pop(ks, None)
         if n:
             metrics.count("state_nullifiers_compacted", n)
         return n
 
     # -- authoritative commit -----------------------------------------------
 
-    def seen(self, hex_digest, epoch=None):
-        return self.store.seen(keyspace_of(epoch), hex_digest)
+    def seen(self, hex_digest, epoch=None, domain=None):
+        return self.store.seen(keyspace_of(epoch, domain), hex_digest)
 
-    def commit(self, hex_digests, epochs=None, accept=None):
+    def commit(self, hex_digests, epochs=None, accept=None, domains=None):
         """Check-and-set under the store lock: for every lane with
         accept[i] truthy, re-check the live set and the batch itself;
         genuinely-new nullifiers are WAL-appended with ONE fsync per
@@ -227,16 +308,20 @@ class NullifierGuard:
         n = len(hex_digests)
         if epochs is None:
             epochs = [None] * n
+        if domains is None:
+            domains = [None] * n
         if accept is None:
             accept = [True] * n
         ok = [False] * n
         with self.store._lock:
             fresh = {}  # ks -> (epoch, [(key, value), ...])
             batch_seen = set()
-            for i, (d, e) in enumerate(zip(hex_digests, epochs)):
+            for i, (d, e, dom) in enumerate(
+                zip(hex_digests, epochs, domains)
+            ):
                 if not accept[i]:
                     continue
-                ks = keyspace_of(e)
+                ks = keyspace_of(e, dom)
                 if (ks, d) in batch_seen or self.store.seen(ks, d):
                     metrics.count("nullifier_double_spends")
                     continue

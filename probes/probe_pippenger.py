@@ -5,7 +5,16 @@ lane against the Python spec, and prints the per-stage split the cost
 model in tpu/backend.py (_bucket_cost/_horner_cost) predicts.
 
 Usage: python probe_pippenger.py [B] [k]   (defaults 16, 32)
-PROBE_MSM_WINDOWS=3,5 limits the window sweep."""
+PROBE_MSM_WINDOWS=3,5 limits the window sweep.
+
+--calibrate (PR 19, ISSUE 18 follow-on): measure the bucket-vs-Horner
+crossover ON THE LIVE BACKEND instead of trusting the cost model.
+Sweeps per-row base counts (PROBE_CALIB_KS, default 4,8,16,32) at
+PROBE_CALIB_B rows (default 8), times the warm Horner schedule against
+each swept window, reports where measurement and _bucket_cost/
+_horner_cost disagree, and emits a COCONUT_MSM_WINDOW recommendation
+line (=0 when Horner wins everywhere swept — the expected verdict on
+the CPU test mesh, where the auto policy already forces Horner)."""
 import os
 import random
 import sys
@@ -20,14 +29,116 @@ from coconut_tpu.ops.curve import G1_GEN, g1
 from coconut_tpu.ops.fields import R
 import coconut_tpu.tpu.backend as tb
 
-B = int(sys.argv[1]) if len(sys.argv) > 1 else 16
-k = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+CALIBRATE = "--calibrate" in sys.argv[1:]
+argv = [a for a in sys.argv[1:] if a != "--calibrate"]
+B = int(argv[0]) if len(argv) > 0 else 16
+k = int(argv[1]) if len(argv) > 1 else 32
 windows = [
     int(w)
     for w in os.environ.get("PROBE_MSM_WINDOWS", "3,5,8").split(",")
 ]
 rng = random.Random(31)
 be = tb.JaxBackend()
+
+nbits_glv = 128 if tb._GLV_ENABLED else 255
+
+
+def make_case(b, kk):
+    p = [
+        [g1.mul(G1_GEN, rng.randrange(1, R)) for _ in range(kk)]
+        for _ in range(b)
+    ]
+    s = [[rng.randrange(R) for _ in range(kk)] for _ in range(b)]
+    s[0][0] = 0
+    return p, s, [g1.msm(pi, si) for pi, si in zip(p, s)]
+
+
+def timed(mode, p, s, r):
+    """Warm time of one schedule on (p, s); asserts spec parity."""
+    tb._BUCKET_MODE = mode
+    be.msm_g1_distinct(p, s)  # build/compile outside the clock
+    t0 = time.time()
+    got = be.msm_g1_distinct(p, s)
+    t = time.time() - t0
+    bad = sum(g != x for g, x in zip(got, r))
+    assert bad == 0, "mode=%r: %d lanes diverge from spec" % (mode, bad)
+    return t
+
+
+def calibrate():
+    calib_b = int(os.environ.get("PROBE_CALIB_B", "8"))
+    ks = [
+        int(x)
+        for x in os.environ.get("PROBE_CALIB_KS", "4,8,16,32").split(",")
+    ]
+    print(
+        "calibrating bucket-vs-Horner crossover: B=%d ks=%r windows=%r "
+        "(GLV=%s -> effective k doubles, %d-bit scalars)"
+        % (calib_b, ks, windows, tb._GLV_ENABLED, nbits_glv)
+    )
+    measured_cross = None  # smallest swept k where a bucketed window wins
+    model_cross = None
+    best_at_max = None  # (window, speedup) at the largest swept k
+    for kk in ks:
+        ek = 2 * kk if tb._GLV_ENABLED else kk
+        p, s, r = make_case(calib_b, kk)
+        t_h = timed("off", p, s, r)
+        c_h = tb._horner_cost(ek, nbits_glv)
+        best_w, best_t = None, t_h
+        for w in windows:
+            t_b = timed(w, p, s, r)
+            verdict_m = "bucket" if t_b < t_h else "horner"
+            verdict_c = (
+                "bucket"
+                if tb._bucket_cost(ek, nbits_glv, w) < c_h
+                else "horner"
+            )
+            print(
+                "  k=%-4d w=%d measured %7.3fs vs horner %7.3fs -> %s"
+                "   (model says %s%s)"
+                % (
+                    kk, w, t_b, t_h, verdict_m, verdict_c,
+                    "" if verdict_m == verdict_c else "  ** DISAGREE",
+                )
+            )
+            if t_b < best_t:
+                best_w, best_t = w, t_b
+        if best_w is not None and measured_cross is None:
+            measured_cross = kk
+        if best_w is not None:
+            best_at_max = (best_w, t_h / best_t)
+        model_w = min(
+            range(2, 9), key=lambda w: tb._bucket_cost(ek, nbits_glv, w)
+        )
+        if (
+            model_cross is None
+            and tb._bucket_cost(ek, nbits_glv, model_w) < c_h
+        ):
+            model_cross = kk
+    print(
+        "calibration: crossover_measured=%s crossover_model=%s"
+        % (measured_cross or "none", model_cross or "none")
+    )
+    if best_at_max is not None:
+        w, speedup = best_at_max
+        print(
+            "recommend COCONUT_MSM_WINDOW=%d for workloads at k>=%d "
+            "(measured x%.2f over Horner at the largest swept shape)"
+            % (w, measured_cross, speedup)
+        )
+    else:
+        print(
+            "recommend COCONUT_MSM_WINDOW=0 (Horner won every swept "
+            "shape on this backend)"
+        )
+    tb._BUCKET_MODE = None
+    print("calibration OK")
+
+
+if CALIBRATE:
+    calibrate()
+    sys.exit(0)
+
 pts = [
     [g1.mul(G1_GEN, rng.randrange(1, R)) for _ in range(k)]
     for _ in range(B)

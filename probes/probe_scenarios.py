@@ -1,0 +1,198 @@
+"""Application-scenario acceptance smoke (the PR-19 fleet drill).
+
+    JAX_PLATFORMS=cpu python probes/probe_scenarios.py
+
+Runs a REAL 3-replica fleet over loopback TCP sockets — each replica a
+ProtocolEngine with its own durable StateStore, anti-entropy
+replication pulling over the same sockets, a GossipLoop feeding the
+router's health directory — and drives a MIXED petition/e-cash/access
+population through a ReplicaRouter for a compressed "day" with one
+flash crowd composed onto the diurnal curve. Asserts the scenario
+layer's acceptance bar:
+
+  - every started workflow reaches exactly one terminal outcome and
+    the run drains clean: zero `failed` (unattributed errors), zero
+    `cancelled` (dangling futures);
+  - the traffic is honest (resign_p = double_spend_p = 0), so zero
+    rejections too — the flash crowd must be ABSORBED (completed or
+    counted as retries/deferrals), never misattributed;
+  - goodput is nonzero and the per-second availability timeline spans
+    the run, flash window included.
+
+Prints a one-line JSON report for the CI log. Python backend, CPU,
+well under two minutes.
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from coconut_tpu import metrics, net
+from coconut_tpu.backend import get_backend
+from coconut_tpu.engine import ProtocolEngine
+from coconut_tpu.keygen import trusted_party_SSS_keygen
+from coconut_tpu.params import Params
+from coconut_tpu.scenarios import (
+    AccessScenario,
+    DiurnalCurve,
+    EcashScenario,
+    FlashCrowd,
+    PetitionScenario,
+    Population,
+    PopulationDriver,
+    RateSchedule,
+    ScenarioReport,
+)
+from coconut_tpu.state import StateReplicator, StateStore
+
+THRESHOLD, TOTAL = 2, 3
+REPLICAS = ("rA", "rB", "rC")
+DURATION_S = 20.0
+
+
+def _engine(signers, params, backend, store):
+    return ProtocolEngine(
+        signers,
+        params,
+        THRESHOLD,
+        count_hidden=1,
+        revealed_msg_indices=[1, 2],
+        backend=backend,
+        devices=1,
+        max_batch=4,
+        max_wait_ms=5.0,
+        state_store=store,
+    ).start()
+
+
+def main():
+    metrics.reset()
+    params = Params.new(3, b"probe-scenarios")
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params)
+    backend = get_backend("python")
+    codec = net.WireCodec(params)
+    root = tempfile.mkdtemp(prefix="probe-scenarios-")
+
+    stores, engines, replicas, clients, reps = {}, {}, {}, {}, {}
+    loop = None
+    try:
+        for rid in REPLICAS:
+            stores[rid] = StateStore(
+                os.path.join(root, rid), replica_id=rid
+            )
+            engines[rid] = _engine(signers, params, backend, stores[rid])
+            replicas[rid] = net.Replica(
+                engines[rid], codec, replica_id=rid
+            )
+            replicas[rid].serve()
+            clients[rid] = net.GatewayClient(
+                net.SocketTransport(replicas[rid].address),
+                codec,
+                session=rid,
+            )
+        router = net.ReplicaRouter(clients)
+        loop = router.gossip_loop(interval_s=0.2).start()
+        directory = router.directory
+        for rid in REPLICAS:
+            peers = {p: clients[p] for p in REPLICAS if p != rid}
+            reps[rid] = StateReplicator(
+                stores[rid], directory, peers, interval_s=0.25
+            )
+            reps[rid].start()
+
+        # honest mixed traffic: the protections must never fire, so a
+        # nonzero rejected/failed count is a detector false positive
+        mix = [
+            (2.0, PetitionScenario(
+                router.bound("petition"), params,
+                campaigns=4, resign_p=0.0,
+            )),
+            (2.0, EcashScenario(
+                router.bound("ecash"), params, double_spend_p=0.0,
+            )),
+            (1.0, AccessScenario(
+                router.bound("access"), params, session_range=(2, 3),
+            )),
+        ]
+        crowd = FlashCrowd(
+            at_s=8.0, duration_s=4.0, multiplier=2.5, ramp_s=2.0
+        )
+        schedule = RateSchedule(
+            DiurnalCurve(0.6, 2.0, DURATION_S), [crowd]
+        )
+        report = ScenarioReport(slo_s=8.0, flash_window=crowd.window())
+        driver = PopulationDriver(
+            Population(64, n_tenants=8, seed=19),
+            mix,
+            schedule,
+            DURATION_S,
+            max_in_flight=48,
+            seed=19,
+            report=report,
+            drain_timeout_s=90.0,
+        )
+        out = driver.run()
+
+        totals = out["totals"]
+        assert totals["failed"] == 0, (
+            "unattributed errors: %r" % (out["error_codes"],)
+        )
+        assert totals["cancelled"] == 0, "dangling futures on drain"
+        assert totals["completed"] > 0, "no workflow completed"
+        assert not out["rejections"], (
+            "honest traffic drew rejections: %r" % (out["rejections"],)
+        )
+        avail = out["availability"]
+        # the pump stops at the LAST arrival (plus drain), which can
+        # land a second or two short of the nominal day length
+        assert avail["seconds"] >= int(DURATION_S) - 3
+        assert sum(avail["per_second_goodput"]) == totals["completed"]
+        flash_arrivals = sum(
+            1 for s in out["timeline"] if 8.0 <= s["t"] <= 12.0
+        )
+        assert flash_arrivals >= 1, "no samples through the flash window"
+
+        line = {
+            "replicas": len(REPLICAS),
+            "arrivals": out["driver"]["arrivals"],
+            "completed": totals["completed"],
+            "retries": totals["retries"],
+            "deferred": out["driver"]["deferred"],
+            "failed": totals["failed"],
+            "cancelled": totals["cancelled"],
+            "goodput_per_s": out["goodput_per_s"],
+            "p99_s": out["slo"]["p99_s"],
+            "flash_p99_s": out["slo"]["flash_p99_s"],
+            "slo_attainment": out["slo"]["attainment"],
+            "users": out["driver"]["users_materialized"],
+        }
+    finally:
+        if loop is not None:
+            loop.stop(timeout=5.0)
+        for rep in reps.values():
+            rep.stop()
+        for c in clients.values():
+            c.close()
+        for r in replicas.values():
+            r.close()
+        for rid, eng in engines.items():
+            assert eng.drain(timeout=60.0), "drain timed out on %s" % rid
+        for st in stores.values():
+            st.close()
+        shutil.rmtree(root, ignore_errors=True)
+
+    print(json.dumps(line, sort_keys=True))
+    print(
+        "scenarios probe: ok (%d arrivals -> %d completed through one "
+        "flash crowd, %d retries, 0 failed, 0 cancelled)"
+        % (line["arrivals"], line["completed"], line["retries"])
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

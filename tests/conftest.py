@@ -133,6 +133,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "scenarios: application-scenario suite (workflow state-machine "
+        "runtime on a fake clock, bit-stable seeded arrival streams, "
+        "petition/e-cash/access flows end-to-end over loopback RPC with "
+        "typed double-spend rejections), also run explicitly by ci.sh's "
+        "scenarios lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",

@@ -145,8 +145,9 @@ def session_objects(world, engine):
 def test_frame_header_golden():
     """The 12-byte header layout is a compatibility promise — pinned."""
     frame = wire.encode_frame(0x01, b"abc", seq=7)
-    # version byte is 03 since PR 17 (state marks + anti-entropy pull)
-    assert frame.hex() == "c0c703010000000700000003616263"
+    # version byte is 04 since PR 19 (scenario nullifier scope on
+    # show_verify requests)
+    assert frame.hex() == "c0c704010000000700000003616263"
     msg_type, seq, payload = wire.decode_frame(frame)
     assert (msg_type, seq, payload) == (0x01, 7, b"abc")
 

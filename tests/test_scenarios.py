@@ -1,0 +1,487 @@
+"""Application-scenario layer suite (PR 19).
+
+Three tiers, matching the subsystem's layering:
+
+  - WORKFLOW UNITS on a fake clock and hand-rolled futures: retry
+    classification (retryable vs expected-typed vs unattributed),
+    per-workflow deadline expiry (at submit time, at park time, and
+    via the driver's expire hook), and the no-dangling-futures-on-
+    drain invariant (a late future settle against a cancelled/expired
+    run is a no-op). Zero real sleeps.
+  - TRAFFIC-MODEL determinism: seeded diurnal/flash/Zipf arrival
+    streams are BIT-STABLE (pinned sha256 over the exact offsets),
+    the population's tenant assignment is a pure function of
+    (seed, uid), and users materialize lazily.
+  - END-TO-END over loopback RPC against a real ProtocolEngine with a
+    durable state store: petition re-sign and e-cash double-spend
+    (exact transcript replay AND fresh re-randomized re-show) surface
+    as typed `rejected`/double_spend terminals; an access session of
+    re-randomized shows is accepted in full.
+
+Everything runs on the python backend with 3-message params.
+"""
+
+import hashlib
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from coconut_tpu import metrics
+from coconut_tpu.backend import get_backend
+from coconut_tpu.engine import ProtocolEngine
+from coconut_tpu.errors import (
+    DoubleSpendError,
+    GeneralError,
+    ServiceOverloadedError,
+)
+from coconut_tpu.keygen import trusted_party_SSS_keygen
+from coconut_tpu.net import rpc, wire
+from coconut_tpu.params import Params
+from coconut_tpu.scenarios import (
+    CANCELLED,
+    COMPLETED,
+    DEADLINE,
+    FAILED,
+    REJECTED,
+    RETRY_EXHAUSTED,
+    AccessScenario,
+    DiurnalCurve,
+    EcashScenario,
+    FlashCrowd,
+    PetitionScenario,
+    Population,
+    RateSchedule,
+    ScenarioReport,
+    Step,
+    Workflow,
+    WorkflowRun,
+    arrival_times,
+    run_workflow,
+    zipf_cdf,
+    zipf_pick,
+)
+from coconut_tpu.state import StateStore
+
+pytestmark = pytest.mark.scenarios
+
+MSGS = 3
+HIDDEN = 1
+REVEALED = [1, 2]
+THRESHOLD, TOTAL = 2, 3
+
+
+# --- workflow units (fake clock, fake futures, zero real sleeps) ------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += max(0.0, s)
+
+
+class FakeFuture:
+    """Future double: resolve/fail now or later; callbacks fire inline
+    when already settled (the ServeFuture contract the runtime leans
+    on)."""
+
+    def __init__(self):
+        self._value = None
+        self._exc = None
+        self._settled = False
+        self._cbs = []
+
+    def resolve(self, value=None):
+        self._value, self._settled = value, True
+        for cb in self._cbs:
+            cb(self)
+
+    def fail(self, exc):
+        self._exc, self._settled = exc, True
+        for cb in self._cbs:
+            cb(self)
+
+    def result(self, timeout=None):
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def add_done_callback(self, fn):
+        if self._settled:
+            fn(self)
+        else:
+            self._cbs.append(fn)
+
+
+class OneStep(Workflow):
+    name = "unit"
+    deadline_s = 10.0
+
+    def __init__(self, submit, max_retries=4):
+        self._submit = submit
+        self._max_retries = max_retries
+        self.result = None
+
+    def script(self):
+        self.result = yield Step(
+            "s", self._submit, max_retries=self._max_retries
+        )
+
+
+def _run_unit(wf, clock=None):
+    clock = clock or FakeClock()
+    run = WorkflowRun(wf, clock=clock, sleep=clock.sleep, seed=1)
+    run.start()
+    return run, clock
+
+
+def test_retryable_errors_are_retried_then_complete():
+    calls = []
+
+    def submit():
+        fut = FakeFuture()
+        calls.append(fut)
+        if len(calls) <= 2:
+            fut.fail(ServiceOverloadedError(1, 1, retry_after_s=0.1))
+        else:
+            fut.resolve("ok")
+        return fut
+
+    run, clock = _run_unit(OneStep(submit))
+    assert run.outcome == COMPLETED
+    assert run.wf.result == "ok"
+    assert run.retries == 2 and len(calls) == 3
+    assert clock.t > 0.0  # the backoff sleeps consumed fake time
+
+
+def test_retry_budget_exhausts_typed():
+    def submit():
+        fut = FakeFuture()
+        fut.fail(ServiceOverloadedError(1, 1, retry_after_s=0.01))
+        return fut
+
+    run, _ = _run_unit(OneStep(submit, max_retries=3))
+    assert run.outcome == RETRY_EXHAUSTED
+    assert run.retries == 3
+    assert run.error_code == "overloaded"
+
+
+def test_expected_typed_terminal_is_rejected_with_label():
+    class Expecting(OneStep):
+        def classify(self, step, exc):
+            if isinstance(exc, DoubleSpendError):
+                return "double_spend"
+            return None
+
+    def submit():
+        fut = FakeFuture()
+        fut.fail(DoubleSpendError("ab" * 32, 0))
+        return fut
+
+    run, _ = _run_unit(Expecting(submit))
+    assert run.outcome == REJECTED
+    assert run.outcome_label == "double_spend"
+    assert run.error_code == "double_spend"
+    assert run.retries == 0  # terminal: never retried
+
+
+def test_unattributed_error_is_failed():
+    def submit():
+        fut = FakeFuture()
+        fut.fail(GeneralError("script bug"))
+        return fut
+
+    run, _ = _run_unit(OneStep(submit))
+    assert run.outcome == FAILED
+    assert run.error_code == "general"
+
+
+def test_deadline_expires_on_retry_past_budget():
+    # the retry hint lands past the 10 s workflow deadline: the run
+    # seals `deadline`, not a useless park
+    def submit():
+        fut = FakeFuture()
+        fut.fail(ServiceOverloadedError(1, 1, retry_after_s=100.0))
+        return fut
+
+    run, clock = _run_unit(OneStep(submit))
+    assert run.outcome == DEADLINE
+    assert clock.t < 10.0  # sealed immediately, no sleep to the hint
+
+
+def test_deadline_expire_hook_while_waiting_on_future():
+    pending = FakeFuture()
+    run, clock = _run_unit(OneStep(lambda: pending))
+    assert run.outcome is None  # waiting on the future
+    clock.t = 11.0
+    run.expire_if_past_deadline(clock.t)
+    assert run.outcome == DEADLINE
+    # the late settle is a no-op (no dangling-future transition)
+    pending.resolve("late")
+    assert run.outcome == DEADLINE
+    assert run.steps_done == 0
+
+
+def test_drain_cancel_leaves_no_dangling_futures():
+    pending = FakeFuture()
+    run, _ = _run_unit(OneStep(lambda: pending))
+    run.cancel()
+    assert run.outcome == CANCELLED
+    pending.fail(GeneralError("late failure"))  # no-op, not FAILED
+    assert run.outcome == CANCELLED
+    assert run._gen is None and run._step is None  # frames dropped
+
+
+def test_parked_retry_resubmits_via_owner():
+    parked = []
+    calls = []
+
+    def submit():
+        fut = FakeFuture()
+        calls.append(fut)
+        if len(calls) == 1:
+            fut.fail(ServiceOverloadedError(1, 1, retry_after_s=0.2))
+        else:
+            fut.resolve("ok")
+        return fut
+
+    clock = FakeClock()
+    run = WorkflowRun(
+        OneStep(submit), clock=clock, sleep=clock.sleep, seed=1,
+        on_park=lambda r, at: parked.append((r, at)),
+    )
+    run.start()
+    assert run.outcome is None and len(parked) == 1
+    r, ready_at = parked[0]
+    assert ready_at > 0.0
+    clock.t = ready_at
+    r.resubmit()
+    assert run.outcome == COMPLETED and run.retries == 1
+
+
+def test_terminal_hooks_fire_exactly_once():
+    seen = []
+    run = WorkflowRun(
+        OneStep(lambda: FakeFuture()), clock=FakeClock(),
+        on_terminal=lambda r: seen.append(r.outcome),
+    )
+    run.start()
+    run.cancel()
+    run.cancel()  # idempotent
+    assert seen == [CANCELLED]
+
+
+# --- traffic model: bit-stable seeded streams --------------------------------
+
+
+def _sched():
+    return RateSchedule(
+        DiurnalCurve(2.0, 10.0, 60.0),
+        [FlashCrowd(30.0, 10.0, 3.0, ramp_s=5.0)],
+    )
+
+
+def test_arrival_stream_bit_stable():
+    a = list(arrival_times(_sched(), 60.0, random.Random(7)))
+    b = list(arrival_times(_sched(), 60.0, random.Random(7)))
+    assert a == b
+    assert a == sorted(a) and all(0.0 <= t < 60.0 for t in a)
+    digest = hashlib.sha256(
+        ",".join("%.12f" % t for t in a).encode()
+    ).hexdigest()
+    assert len(a) == 659
+    assert digest == (
+        "7b8264c22c1acbf0114014ce7b84d07e4f350acda58b61f466a8a0bf830d7a75"
+    )
+
+
+def test_diurnal_and_flash_shapes():
+    c = DiurnalCurve(2.0, 10.0, 60.0)
+    assert c.rate(0.0) == pytest.approx(2.0)
+    assert c.rate(30.0) == pytest.approx(10.0)
+    assert c.rate(60.0) == pytest.approx(2.0)
+    f = FlashCrowd(30.0, 10.0, 3.0, ramp_s=5.0)
+    assert f.factor(0.0) == 1.0
+    assert f.factor(27.5) == pytest.approx(2.0)  # mid-ramp
+    assert f.factor(35.0) == 3.0
+    assert f.factor(50.0) == 1.0
+    assert f.window() == (30.0, 40.0)
+    # the composed schedule's arrivals cluster where the rate is high
+    a = list(arrival_times(_sched(), 60.0, random.Random(7)))
+    in_flash = sum(1 for t in a if 30.0 <= t <= 40.0)
+    head = sum(1 for t in a if t <= 10.0)
+    assert in_flash > 3 * head
+
+
+def test_zipf_skew_and_determinism():
+    cdf = zipf_cdf(8, 1.2)
+    assert len(cdf) == 8 and cdf[-1] == 1.0
+    assert all(b > a for a, b in zip(cdf, cdf[1:]))
+    rng = random.Random(3)
+    picks = [zipf_pick(rng, cdf) for _ in range(20)]
+    assert picks == [0, 1, 0, 1, 2, 0, 0, 4, 0, 0,
+                     7, 1, 4, 1, 2, 0, 2, 4, 1, 3]
+    counts = [0] * 8
+    rng = random.Random(9)
+    for _ in range(4000):
+        counts[zipf_pick(rng, cdf)] += 1
+    assert counts[0] > counts[1] > counts[7]  # rank skew
+
+
+def test_population_lazy_and_deterministic():
+    p1 = Population(1_000_000, n_tenants=8, seed=3)
+    p2 = Population(1_000_000, n_tenants=8, seed=3)
+    assert p1.materialized() == 0  # millions of users cost nothing
+    uids = [0, 1, 17, 999_999]
+    assert [p1.tenant_of(u) for u in uids] == [
+        p2.tenant_of(u) for u in uids
+    ]
+    u = p1.user(17)
+    assert p1.user(17) is u and p1.materialized() == 1
+    assert u.seed == p2.user(17).seed
+    # a different population seed shuffles tenants
+    p3 = Population(1_000_000, n_tenants=8, seed=4)
+    assert any(
+        p1.tenant_of(u) != p3.tenant_of(u) for u in range(64)
+    )
+
+
+def test_report_attributes_outcomes():
+    rep = ScenarioReport(slo_s=2.0, flash_window=(5.0, 8.0))
+    rep.t0 = 100.0
+
+    def fake_run(outcome, name="petition", label=None, code=None,
+                 t_end=101.0, dur=0.5):
+        return SimpleNamespace(
+            wf=SimpleNamespace(name=name), outcome=outcome,
+            outcome_label=label, error_code=code, retries=1,
+            t_start=t_end - dur, t_end=t_end,
+        )
+
+    rep.record(fake_run(COMPLETED))
+    rep.record(fake_run(COMPLETED, t_end=106.5))  # inside flash window
+    rep.record(fake_run(REJECTED, label="double_spend"))
+    rep.record(fake_run(FAILED, code="general"))
+    rep.sample(0.0, in_flight=3, active_executors=2)
+    out = rep.build(100.0, 10.0)
+    assert out["totals"]["completed"] == 2
+    assert out["totals"]["rejected_expected"] == 1
+    assert out["totals"]["failed"] == 1
+    assert out["rejections"]["petition"]["double_spend"] == 1
+    assert out["error_codes"]["general"] == 1
+    assert out["slo"]["attainment"] == 1.0
+    assert out["slo"]["flash_completed"] == 1
+    assert out["timeline"][0]["active_executors"] == 2
+    # rejections are neither goodput nor errors
+    avail = out["availability"]
+    assert sum(avail["per_second_goodput"]) == 2
+    assert sum(avail["per_second_errors"]) == 1
+
+
+# --- end-to-end over loopback RPC -------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def world():
+    params = Params.new(MSGS, b"test-scenarios")
+    _, _, signers = trusted_party_SSS_keygen(THRESHOLD, TOTAL, params)
+    return SimpleNamespace(
+        params=params,
+        signers=signers,
+        backend=get_backend("python"),
+        codec=wire.WireCodec(params),
+    )
+
+
+@pytest.fixture()
+def loop(world, tmp_path):
+    store = StateStore(str(tmp_path / "wal"), replica_id="rA")
+    engine = ProtocolEngine(
+        world.signers,
+        world.params,
+        THRESHOLD,
+        count_hidden=HIDDEN,
+        revealed_msg_indices=REVEALED,
+        backend=world.backend,
+        devices=1,
+        max_batch=4,
+        max_wait_ms=5.0,
+        state_store=store,
+    ).start()
+    replica = rpc.Replica(engine, world.codec, replica_id="rA")
+    client = rpc.GatewayClient(
+        rpc.LoopbackTransport(replica), world.codec
+    )
+    yield SimpleNamespace(client=client, engine=engine, store=store)
+    replica.close()
+    assert engine.drain(timeout=60.0)
+    store.close()
+
+
+def test_petition_sign_resign_and_second_campaign_e2e(loop, world):
+    sc = PetitionScenario(
+        loop.client, world.params, campaigns=2, resign_p=0.0
+    )
+    user = Population(8, seed=11).user(0)
+    r1 = run_workflow(sc.workflow(user, random.Random(1)))
+    assert r1.outcome == COMPLETED, r1.error_code
+    assert len(user.signed) == 1 and user.credential is not None
+
+    # same credential, OTHER campaign: allowed (different domain)
+    r2 = run_workflow(sc.workflow(user, random.Random(2)))
+    assert r2.outcome == COMPLETED, r2.error_code
+    assert user.signed == {0, 1}
+
+    # both campaigns signed -> the script deliberately re-signs one;
+    # the FRESH re-randomized show must be caught by the campaign-
+    # scoped spend tag and surface as the typed expected rejection
+    r3 = run_workflow(sc.workflow(user, random.Random(3)))
+    assert r3.outcome == REJECTED
+    assert r3.outcome_label == "double_spend"
+    assert r3.error_code == "double_spend"
+    assert user.signed == {0, 1}  # rejection did not grow the set
+
+
+def test_ecash_double_spend_rejected_e2e(loop, world):
+    sc = EcashScenario(loop.client, world.params, double_spend_p=1.0)
+    user = Population(8, seed=12).user(1)
+    # first run: honest spend, then a FRESH re-show of the spent coin
+    # (shows_done parity 1 -> odd branch)
+    r1 = run_workflow(sc.workflow(user, random.Random(5)))
+    assert r1.outcome == REJECTED
+    assert r1.outcome_label == "double_spend"
+    assert user.coin is None  # the honest spend consumed the coin
+    assert user.spent_show is not None
+    # second run: new coin, honest spend, then an EXACT transcript
+    # replay (parity 2 -> even branch) — also caught
+    r2 = run_workflow(sc.workflow(user, random.Random(6)))
+    assert r2.outcome == REJECTED
+    assert r2.outcome_label == "double_spend"
+
+
+def test_ecash_honest_spend_completes_e2e(loop, world):
+    sc = EcashScenario(loop.client, world.params, double_spend_p=0.0)
+    user = Population(8, seed=13).user(2)
+    r = run_workflow(sc.workflow(user, random.Random(8)))
+    assert r.outcome == COMPLETED, r.error_code
+    assert user.coin is None and user.shows_done == 1
+
+
+def test_access_session_rerandomized_shows_all_accepted_e2e(loop, world):
+    metrics.reset()
+    sc = AccessScenario(
+        loop.client, world.params, session_range=(3, 3)
+    )
+    user = Population(8, seed=14).user(3)
+    r = run_workflow(sc.workflow(user, random.Random(9)))
+    assert r.outcome == COMPLETED, r.error_code
+    assert user.shows_done == 3
+    # prepare + mint + 3 x (show_prove + show_verify)
+    assert r.steps_done == 8
+    assert metrics.get_count("scenario_completed") == 1
+    assert metrics.get_count("scenario_failed") == 0
