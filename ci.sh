@@ -20,6 +20,16 @@ make -C native selftest_asan
 echo "== test suite (both group assignments in-suite) =="
 python -m pytest tests/ -q
 
+echo "== analysis lane (invariant lint suite + CI gate) =="
+# the marker suite: each checker fires on its seeded-bad fixture, the
+# runtime lock-order tracker catches a real ABBA interleaving, the
+# dead-letter schema validator rejects malformed records
+python -m pytest tests/test_analysis.py -m analysis -q
+# the gate: lock-order / wire-contract / const-time / durability /
+# metrics-doc over the tree; any finding not covered by an inline
+# ``# lint: allow(...)`` pragma or analysis_baseline.json fails CI
+python -m coconut_tpu.analysis --fail-on-new
+
 echo "== fault-supervision lane (retry/fallback/bisection/checkpoints) =="
 python -m pytest tests/test_faults.py -m faults -q
 # dead-letter JSONL schema probe: run a tiny grouped stream with one forged
@@ -44,19 +54,12 @@ class Grouped:
 verify_stream(source, 3, None, None, Grouped(), mode="grouped",
               dead_letter_path=os.environ["DLQ_PATH"])
 EOF
-grep -q '"batch": 1' "$DLQ"
-grep -q '"credential": 2' "$DLQ"
-grep -q '"reason"' "$DLQ"
-grep -q '"attempts"' "$DLQ"
-# schema v4: every line carries trace join keys (null with tracing off),
-# the engine program name (null on the offline stream path), and the
-# nullifier digest (null off the show-verify double-spend path)
-grep -q '"schema": 4' "$DLQ"
-grep -q '"trace_id"' "$DLQ"
-grep -q '"span_id"' "$DLQ"
-grep -q '"program"' "$DLQ"
-grep -q '"nullifier"' "$DLQ"
-echo "dead-letter schema: ok"
+# structured schema-v4 validation (replaces the old grep chain, which
+# passed on wrong types and torn lines): every line must parse, carry
+# exactly the v4 key set with the right types/null-ability, and the
+# bisected culprit must be batch 1 / credential 2
+python -m coconut_tpu.analysis.schema "$DLQ" \
+  --expect batch=1 --expect credential=2
 
 echo "== serve lane (dynamic batching / admission control / loadgen) =="
 # "not slow": the mesh-serve integration test already ran in the full
@@ -123,8 +126,11 @@ EOF
 echo "== chaos lane (self-healing pool: crash containment / watchdog / brownout) =="
 # the marker suite: breaker/watchdog/brownout units (tests/test_health.py),
 # fake-clock crash/hang/quarantine/probation integration (test_serve.py),
-# injection + rotation + crash-atomic checkpoint satellites (test_faults.py)
-python -m pytest tests/ -m chaos -q
+# injection + rotation + crash-atomic checkpoint satellites (test_faults.py).
+# COCONUT_LOCK_CHECK=1 runs the whole lane under the runtime lock-order
+# tracker (analysis/lockcheck.py): any acquisition-order inversion
+# recorded during a test fails that test
+COCONUT_LOCK_CHECK=1 python -m pytest tests/ -m chaos -q
 # end-to-end acceptance smoke (ISSUE 9): a real 8-executor stub-device
 # service takes one injected executor crash AND one hung dispatch mid-run;
 # the probe asserts every submitted future settled, the culprits were

@@ -41,7 +41,21 @@ class CoconutError(Exception):
 
 
 class UnsupportedNoOfMessages(CoconutError):
-    """Verkey valid for `expected` messages but given `given` (errors.rs:7-11)."""
+    """Verkey valid for `expected` messages but given `given` (errors.rs:7-11).
+
+    Raised on RPC-reachable paths (signature.py / ps.py / pok_sig.py run
+    server-side under the engine's mint and show-verify handlers), so it
+    carries a stable wire code — without one it would cross the wire as a
+    GeneralError and clients could no longer distinguish "wrong message
+    count" (a permanent caller bug) from a generic failure."""
+
+    code = "unsupported_messages"
+
+    # class-level defaults: error_from_wire rebuilds non-retryable errors
+    # via cls.__new__ + CoconutError.__init__, which never runs this
+    # subclass __init__ — attribute reads must still succeed
+    expected = None
+    given = None
 
     def __init__(self, expected, given):
         super().__init__(
@@ -50,9 +64,26 @@ class UnsupportedNoOfMessages(CoconutError):
         self.expected = expected
         self.given = given
 
+    def _restore_wire_fields(self, message):
+        # the message format above is part of the wire contract: the
+        # structured counts survive the round trip
+        m = re.search(r"valid for (\d+) messages but given (\d+)", message)
+        if m is not None:
+            self.expected = int(m.group(1))
+            self.given = int(m.group(2))
+
 
 class UnequalNoOfBasesExponents(CoconutError):
-    """Same number of bases and exponents required (errors.rs:13-17)."""
+    """Same number of bases and exponents required (errors.rs:13-17).
+
+    Wire-coded for the same reason as UnsupportedNoOfMessages: it is
+    raised under the engine's show-verify handler (pok_vc.py /
+    signature.py) on malformed proofs."""
+
+    code = "unequal_bases_exponents"
+
+    bases = None
+    exponents = None
 
     def __init__(self, bases, exponents):
         super().__init__(
@@ -62,9 +93,21 @@ class UnequalNoOfBasesExponents(CoconutError):
         self.bases = bases
         self.exponents = exponents
 
+    def _restore_wire_fields(self, message):
+        m = re.search(r"(\d+) bases and (\d+) exponents", message)
+        if m is not None:
+            self.bases = int(m.group(1))
+            self.exponents = int(m.group(2))
+
 
 class PSError(CoconutError):
-    """Error raised by the PS-signature layer (errors.rs:19-20; ps_sig::errors)."""
+    """Error raised by the PS-signature layer (errors.rs:19-20; ps_sig::errors).
+
+    Wire-coded: ps.py's checks run under the engine's mint/show handlers,
+    and a PS-layer refusal must stay distinguishable from a GeneralError
+    across the gateway."""
+
+    code = "ps_error"
 
 
 class DeserializationError(CoconutError):
@@ -416,6 +459,9 @@ WIRE_ERROR_CODES = {
     for cls in (
         GeneralError,
         DeserializationError,
+        UnsupportedNoOfMessages,
+        UnequalNoOfBasesExponents,
+        PSError,
         TransientBackendError,
         ServiceRetryableError,
         ServiceOverloadedError,

@@ -614,6 +614,8 @@ class DeadLetterLog:
             record_count=self._records,
         ):
             self._records = 0
+        # lint: allow(durability, append-only JSONL; read() skips+counts a
+        # torn tail, so a crash mid-append loses at most this one record)
         with open(self.path, "a") as f:
             f.write(json.dumps(rec, sort_keys=True) + "\n")
         self._records += 1
@@ -650,11 +652,28 @@ class DeadLetterLog:
         Older records are normalized on read: absent trace fields become
         null (pre-v2), absent program becomes null (pre-v3), absent
         nullifier becomes null (pre-v4), absent schema becomes 1 —
-        readers never need per-version key checks."""
+        readers never need per-version key checks.
+
+        Torn-tail tolerant (the WAL's recovery contract, in miniature):
+        the append path is plain JSONL, so a crash mid-append can leave
+        a truncated final line. Unparseable lines are skipped and
+        counted under "dead_letter_torn_lines" instead of poisoning
+        every future read() — and, through the lazy record count above,
+        every future append()."""
         if not os.path.exists(path):
             return []
+        recs = []
+        torn = 0
         with open(path) as f:
-            recs = [json.loads(line) for line in f if line.strip()]
+            for line in f:
+                if not line.strip():
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    torn += 1
+        if torn:
+            metrics.count("dead_letter_torn_lines", torn)
         for rec in recs:
             rec.setdefault("schema", 1)
             rec.setdefault("trace_id", None)

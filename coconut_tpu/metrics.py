@@ -3,9 +3,10 @@
 
 The reference has no observability at all (errors are the only signal —
 SURVEY §5); this module provides the minimum the framework's own survey
-demands: per-phase wall-clock timers (host encode / device compile / kernel /
-readback), monotonic counters (verifies, batches, transfer bytes), bounded
-latency histograms with percentile readout (the serving layer's per-request
+demands: per-phase wall-clock timers — "encode" (host limb encode),
+"kernel" (device dispatch), "readback" (device->host transfer) — monotonic
+counters "verifies" / "batches" / "transfer_bytes", bounded latency
+histograms with percentile readout (the serving layer's per-request
 SLO surface), and a `snapshot()` the bench harness embeds in its JSON output
 so TPU claims are auditable.
 
@@ -41,7 +42,20 @@ The online serving layer (coconut_tpu/serve/) reports: "serve_admitted" /
 "serve_batched_requests" / "serve_pad_lanes" (coalescing — mean batch
 occupancy is batched_requests / (batches * max_batch)), "serve_valid" /
 "serve_invalid" / "serve_failed_requests" / "serve_cancelled" (outcomes),
-and the "serve_latency_s" / "serve_batch_wait_s" histograms.
+"future_callback_errors" (future done-callbacks that raised — contained,
+never propagated into the settling thread), and the "serve_latency_s" /
+"serve_batch_wait_s" histograms.
+
+Every OTHER engine program reports the same shape under its own
+namespace (`<ns>` is the program's metric namespace: "prep", "issue",
+"prove", "showv" — the verify pool keeps the legacy "serve" prefix):
+"<ns>_done" (requests settled OK), "<ns>_pad_lanes" (lanes padded to
+the program's pad convention), "<ns>_valid" / "<ns>_invalid" (verdict
+programs), "<ns>_failed_requests" / "<ns>_cancelled" (failure
+outcomes), and the "<ns>_latency_s" histogram. The ragged show-verify
+host fallback counts "show_verify_ragged_proofs" (proofs verified on
+the ragged path) / "show_verify_ragged_fallback" (batches that took
+it).
 
 The mesh-scale dispatcher pool adds PER-DEVICE and placement surfaces:
 each device executor `<d>` counts "serve_dev<d>_dispatches" /
@@ -63,7 +77,9 @@ HEALTHY), "serve_watchdog_timeouts" (hung dispatches expired),
 "serve_redistributed_batches" / "serve_redistributed_requests" (unsettled
 work re-placed onto survivors), "serve_redispatch_exhausted" (poisonous
 batches failed after the hop cap), "serve_shed_bulk" (brownout sheds),
-and "rotations" / "rotation_errors" (dead-letter/flight JSONL rotation).
+and "rotations" / "rotation_errors" (dead-letter/flight JSONL rotation)
+plus "flight_torn_lines" (unparseable flight-recorder lines skipped on
+read after a crash mid-append).
 Gauges: "serve_dev<d>_health" (the state string), "serve_healthy_executors"
 (admissible pool size), "serve_brownout" (0/1 shed-mode flag).
 
@@ -142,7 +158,9 @@ to commit time, never admits a double-spend), "nullifier_commit_errors"
 TransientBackendError: no resolve without durability),
 "gateway_tenant_store_errors" / "dead_letter_index_errors" /
 "dead_letter_errors" (lazy-durability write failures in the adopted
-subsystems, counted and survived).
+subsystems, counted and survived), and "dead_letter_torn_lines"
+(unparseable dead-letter JSONL lines skipped on read — a crash
+mid-append tears at most the final line).
 
 The APPLICATION SCENARIO layer (coconut_tpu/scenarios/, PR 19) reports
 under "scenario_*": "scenario_started" (workflows admitted by the

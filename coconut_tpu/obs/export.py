@@ -36,6 +36,8 @@ def span_records(spans):
 def write_jsonl(spans, path):
     """One span record per line; returns the record count."""
     recs = span_records(spans)
+    # lint: allow(durability, on-demand trace export artifact - rewritten
+    # whole per call, nothing re-reads it across a crash)
     with open(path, "w") as f:
         for rec in recs:
             f.write(json.dumps(rec, sort_keys=True) + "\n")
@@ -94,6 +96,8 @@ def write_chrome(spans, path, pid=1):
     """Write the Perfetto-loadable JSON document; returns the event
     count."""
     events = chrome_events(spans, pid=pid)
+    # lint: allow(durability, on-demand trace export artifact - rewritten
+    # whole per call, nothing re-reads it across a crash)
     with open(path, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return len(events)

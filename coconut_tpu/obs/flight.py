@@ -110,6 +110,8 @@ def record(base_path, reason, trace_id=None, extra=None, last_n=DEFAULT_LAST_N):
         flight_path(base_path), max_bytes=FLIGHT_MAX_BYTES, keep=FLIGHT_KEEP
     )
     try:
+        # lint: allow(durability, best-effort append-only observability
+        # artifact; read() skips+counts a torn tail)
         with open(flight_path(base_path), "a") as f:
             f.write(json.dumps(rec, sort_keys=True) + "\n")
     except OSError:
@@ -121,12 +123,26 @@ def record(base_path, reason, trace_id=None, extra=None, last_n=DEFAULT_LAST_N):
 
 def read(path):
     """All flight records in `path` (empty list if it does not exist) —
-    accepts either the base path or the .flight.jsonl path itself."""
+    accepts either the base path or the .flight.jsonl path itself.
+    Torn-tail tolerant like DeadLetterLog.read: a crash mid-append can
+    truncate the final line; skip it (counted under
+    "flight_torn_lines") instead of poisoning every later read."""
     import os
 
     if not path.endswith(".flight.jsonl"):
         path = flight_path(path)
     if not os.path.exists(path):
         return []
+    recs = []
+    torn = 0
     with open(path) as f:
-        return [json.loads(line) for line in f if line.strip()]
+        for line in f:
+            if not line.strip():
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                torn += 1
+    if torn:
+        metrics.count("flight_torn_lines", torn)
+    return recs

@@ -253,6 +253,8 @@ def fr_digits_signed_np(scalars, nwin=52, window=5):
     mag_dtype = np.uint8 if half <= 255 else np.int16
     acc_dtype = np.int16 if window <= 10 else np.int32
     nbytes = (nwin * window + 7) // 8
+    # lint: allow(const-time, CONSTTIME.md §1 host caveat - big-int reduce +
+    # to_bytes cost tracks bit length; accepted on the host recode path)
     buf = b"".join((int(s) % R).to_bytes(nbytes, "little") for s in scalars)
     bits = np.unpackbits(
         np.frombuffer(buf, dtype=np.uint8).reshape(-1, nbytes),
@@ -272,5 +274,7 @@ def fr_digits_signed_np(scalars, nwin=52, window=5):
         c = over.astype(acc_dtype)
         mag[:, nwin - 1 - w] = np.abs(d).astype(mag_dtype)
         neg[:, nwin - 1 - w] = d < 0
+    # lint: allow(const-time, carry is structurally zero for every Fr input -
+    # the branch direction is input-independent)
     assert not c.any()  # Fr < 2^255: the top window absorbs every carry
     return mag, neg

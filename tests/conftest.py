@@ -12,7 +12,10 @@ needed:
     var alone is overridden. config.update wins over both.
 """
 
+import faulthandler
 import os
+
+import pytest
 
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
@@ -37,8 +40,34 @@ try:
 except ImportError:  # pragma: no cover - jax is baked into this image
     pass
 
+try:
+    from coconut_tpu.analysis import lockcheck as _lockcheck
+except ImportError:  # pragma: no cover - analysis rides with the package
+    _lockcheck = None
+
 
 def pytest_configure(config):
+    # Hang diagnosis: the driver's tier-1 run is killed at a hard wall
+    # (timeout -k 10 870) with no stacks. Dump EVERY thread's traceback
+    # shortly before that wall so a wedged run names its culprit (a
+    # stuck Condition.wait, a hung dispatch) instead of dying silent.
+    # COCONUT_TEST_DUMP_S=0 disables; exit=False — diagnose, don't kill.
+    faulthandler.enable()
+    try:
+        _dump_s = float(os.environ.get("COCONUT_TEST_DUMP_S", "840"))
+    except ValueError:
+        _dump_s = 840.0
+    if _dump_s > 0:
+        faulthandler.dump_traceback_later(_dump_s, exit=False)
+
+    # Runtime lock-order tracking (ISSUE 20): COCONUT_LOCK_CHECK=1
+    # patches threading.Lock/RLock so every lock allocated by
+    # coconut_tpu code records the global acquisition-order graph; the
+    # autouse guard below fails any test that recorded an inversion.
+    # Opt-in via env so the default tier-1 run is byte-identical.
+    if _lockcheck is not None and _lockcheck.env_enabled():
+        config._coconut_lock_tracker = _lockcheck.install()
+
     config.addinivalue_line(
         "markers",
         "heavy: multi-minute at-scale fused-kernel tests, run by ci.sh's "
@@ -141,7 +170,39 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "analysis: invariant lint suite (static checkers' seeded-bad "
+        "fixtures + clean-tree gate, runtime lock-order tracker, "
+        "dead-letter schema validator), also run explicitly by ci.sh's "
+        "analysis lane",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: multi-minute tests (virtual-mesh program tracing/execution) "
         "excluded from the driver's bounded tier-1 run (-m 'not slow'); "
         "ci.sh's full-suite pass still runs them",
+    )
+
+
+def pytest_unconfigure(config):
+    faulthandler.cancel_dump_traceback_later()
+    tracker = getattr(config, "_coconut_lock_tracker", None)
+    if tracker is not None and _lockcheck is not None:
+        _lockcheck.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_guard(request):
+    """With COCONUT_LOCK_CHECK=1, fail any test during which coconut_tpu
+    code acquired locks in an order that inverts a previously observed
+    order (the two paths can deadlock under the right interleaving)."""
+    tracker = getattr(request.config, "_coconut_lock_tracker", None)
+    if tracker is None:
+        yield
+        return
+    tracker.drain_inversions()  # don't blame this test for earlier ones
+    yield
+    inversions = tracker.drain_inversions()
+    assert not inversions, (
+        "lock acquisition-order inversion(s) recorded during this test "
+        "(COCONUT_LOCK_CHECK): %r" % (inversions,)
     )
